@@ -1,0 +1,10 @@
+"""odh_kubeflow_tpu — a TPU-native notebook workbench operator framework.
+
+A from-scratch re-imagining of the ODH Kubeflow notebook-controller stack
+(see SURVEY.md / ARCHITECTURE.md): Kubernetes-style API machinery, an
+in-process control plane, a controller runtime, the Notebook operator suite
+(core reconciler, mutating webhook, culler, TPU extension), and the JAX-side
+components (slice planner, in-pod probe, workbench workload library).
+"""
+
+__version__ = "0.1.0"

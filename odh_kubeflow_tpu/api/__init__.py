@@ -1,0 +1,2 @@
+from . import apps, core, gateway, networking, rbac
+from . import notebook

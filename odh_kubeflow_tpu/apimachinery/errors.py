@@ -1,0 +1,71 @@
+"""Structured API errors, mirroring k8s.io/apimachinery/pkg/api/errors semantics
+the reference relies on (IsNotFound / IsAlreadyExists / IsConflict branches in
+every reconciler)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ApiError(Exception):
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = "", *, kind: str = "", name: str = ""):
+        self.kind = kind
+        self.name = name
+        if not message and kind:
+            message = f'{self.reason}: {kind} "{name}"'
+        super().__init__(message or self.reason)
+
+
+class NotFoundError(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """resourceVersion mismatch on update — optimistic-concurrency failure."""
+
+    code = 409
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class ForbiddenError(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+class AdmissionDeniedError(ApiError):
+    """A mutating/validating webhook rejected the request (failurePolicy: Fail)."""
+
+    code = 400
+    reason = "AdmissionDenied"
+
+
+def is_not_found(err: Optional[BaseException]) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_conflict(err: Optional[BaseException]) -> bool:
+    return isinstance(err, ConflictError)
+
+
+def is_already_exists(err: Optional[BaseException]) -> bool:
+    return isinstance(err, AlreadyExistsError)
+
+
+def ignore_not_found(err: Optional[BaseException]) -> None:
+    """client.IgnoreNotFound analog: re-raise anything but NotFound."""
+    if err is None or is_not_found(err):
+        return None
+    raise err

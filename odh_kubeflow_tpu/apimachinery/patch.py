@@ -1,0 +1,30 @@
+"""JSON merge patch (RFC 7386) + helpers.
+
+The reference uses JSON-merge-patch to atomically clear the reconciliation-lock
+annotation (odh notebook_controller.go RemoveReconciliationLock: patches the
+stop annotation to null); this implements the same semantics against our store.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+
+def json_merge_patch(target: Any, patch: Any) -> Any:
+    """Apply RFC 7386: dict keys merge recursively, None deletes, scalars/lists replace."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(target, dict):
+        target = {}
+    result = copy.deepcopy(target)
+    for k, v in patch.items():
+        if v is None:
+            result.pop(k, None)
+        else:
+            result[k] = json_merge_patch(result.get(k), v)
+    return result
+
+
+def annotation_patch(annotations: Dict[str, Any]) -> Dict[str, Any]:
+    """Build a merge patch touching only metadata.annotations (None value deletes)."""
+    return {"metadata": {"annotations": dict(annotations)}}

@@ -1,0 +1,181 @@
+"""Unit tests for the from-scratch API machinery (serde, meta, labels, patch)."""
+import copy
+
+from odh_kubeflow_tpu.apimachinery import (
+    Condition,
+    LabelSelector,
+    LabelSelectorRequirement,
+    json_merge_patch,
+    match_labels,
+    sanitize_name,
+    set_condition,
+)
+from odh_kubeflow_tpu.api.core import Container, EnvVar, Pod, PodSpec, Probe, Service
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.apimachinery.scheme import default_scheme
+
+
+def test_roundtrip_camel_case():
+    pod = Pod(api_version="v1", kind="Pod")
+    pod.metadata.name = "nb-0"
+    pod.metadata.namespace = "user-ns"
+    pod.metadata.labels = {"notebook-name": "nb"}
+    pod.spec.containers.append(
+        Container(name="nb", image="img:1", env=[EnvVar(name="NB_PREFIX", value="/x")])
+    )
+    d = pod.to_dict()
+    assert d["metadata"]["name"] == "nb-0"
+    assert d["spec"]["containers"][0]["env"][0] == {"name": "NB_PREFIX", "value": "/x"}
+    back = Pod.from_dict(d)
+    assert back.spec.containers[0].env[0].value == "/x"
+    assert back.metadata.labels == {"notebook-name": "nb"}
+
+
+def test_omitempty():
+    svc = Service(api_version="v1", kind="Service")
+    svc.metadata.name = "s"
+    d = svc.to_dict()
+    assert "labels" not in d["metadata"]
+    assert "status" not in d  # empty dict field omitted (Go map omitempty)
+    assert d["spec"] == {}  # struct fields always emitted (Go struct semantics)
+
+
+def test_optional_int_zero_survives():
+    from odh_kubeflow_tpu.api.apps import StatefulSet
+
+    sts = StatefulSet()
+    sts.spec.replicas = 0
+    d = sts.to_dict()
+    assert d["spec"]["replicas"] == 0
+    back = StatefulSet.from_dict(d)
+    assert back.spec.replicas == 0
+    sts.spec.replicas = None
+    assert "replicas" not in sts.to_dict()["spec"]
+
+
+def test_required_empty_selector_survives():
+    from odh_kubeflow_tpu.api.networking import NetworkPolicy
+
+    np = NetworkPolicy()
+    d = np.to_dict()
+    assert d["spec"]["podSelector"] == {}  # select-all must not vanish
+
+
+def test_scheme_hub_gvk_stable():
+    gvk = default_scheme.gvk_for(Notebook)
+    assert gvk.api_version == "kubeflow.org/v1beta1"
+
+
+def test_owner_refs():
+    from odh_kubeflow_tpu.api.apps import StatefulSet
+
+    nb = Notebook(api_version="kubeflow.org/v1beta1", kind="Notebook")
+    nb.metadata.name = "nb"
+    nb.metadata.uid = "u1"
+    other = Notebook(api_version="kubeflow.org/v1beta1", kind="Notebook")
+    other.metadata.name = "other"
+    other.metadata.uid = "u2"
+    sts = StatefulSet(api_version="apps/v1", kind="StatefulSet")
+    sts.set_owner(nb)
+    sts.set_owner(other, controller=False)
+    # non-controller add must not evict the controller ref
+    assert any(r.controller for r in sts.metadata.owner_references)
+    assert len(sts.metadata.owner_references) == 2
+    assert sts.owned_by(nb) and sts.owned_by(other)
+    # empty-uid objects never match an owner with a different identity
+    sts2 = StatefulSet(api_version="apps/v1", kind="StatefulSet")
+    assert not sts2.owned_by(nb)
+
+
+def test_unknown_fields_roundtrip():
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p"},
+        "spec": {"containers": [], "futureField": {"x": 1}},
+    }
+    pod = Pod.from_dict(d)
+    out = pod.to_dict()
+    assert out["spec"]["futureField"] == {"x": 1}
+
+
+def test_probe_exec_json_key():
+    p = Probe(exec_={"command": ["true"]})
+    assert p.to_dict() == {"exec": {"command": ["true"]}}
+    assert Probe.from_dict({"exec": {"command": ["x"]}}).exec_ == {"command": ["x"]}
+
+
+def test_notebook_tpu_block_roundtrip():
+    nb = Notebook(api_version="kubeflow.org/v1beta1", kind="Notebook")
+    nb.metadata.name = "trainer"
+    nb.spec.tpu = TPUSpec(accelerator="v5p", topology="2x2x4")
+    nb.spec.template.spec.containers.append(Container(name="trainer", image="jax:latest"))
+    d = nb.to_dict()
+    assert d["spec"]["tpu"] == {"accelerator": "v5p", "topology": "2x2x4"}
+    back = default_scheme.decode(d)
+    assert isinstance(back, Notebook)
+    assert back.spec.tpu.accelerator == "v5p"
+
+
+def test_reference_shaped_manifest_parses():
+    # A CR written for the reference controller (no tpu block) parses unchanged.
+    d = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": "jupyter", "namespace": "kubeflow"},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "jupyter",
+                            "image": "jupyter/minimal",
+                            "resources": {"requests": {"cpu": "500m"}},
+                        }
+                    ]
+                }
+            }
+        },
+    }
+    nb = Notebook.from_dict(d)
+    assert nb.spec.tpu is None
+    assert nb.spec.template.spec.containers[0].resources.requests["cpu"] == "500m"
+
+
+def test_label_selector():
+    sel = LabelSelector(
+        match_labels={"app": "nb"},
+        match_expressions=[
+            LabelSelectorRequirement(key="tier", operator="In", values=["gold"])
+        ],
+    )
+    assert sel.matches({"app": "nb", "tier": "gold"})
+    assert not sel.matches({"app": "nb", "tier": "silver"})
+    assert not sel.matches({"tier": "gold"})
+    assert match_labels({"a": "1"}, {"a": "1", "b": "2"})
+    assert not match_labels({"a": "1"}, {"b": "2"})
+
+
+def test_json_merge_patch_deletes_annotation():
+    obj = {"metadata": {"annotations": {"kubeflow-resource-stopped": "lock", "keep": "1"}}}
+    out = json_merge_patch(obj, {"metadata": {"annotations": {"kubeflow-resource-stopped": None}}})
+    assert out["metadata"]["annotations"] == {"keep": "1"}
+    # original untouched
+    assert "kubeflow-resource-stopped" in obj["metadata"]["annotations"]
+
+
+def test_set_condition_preserves_transition_time():
+    conds = set_condition([], Condition(type="Ready", status="True"))
+    t0 = conds[0].last_transition_time
+    conds = set_condition(conds, Condition(type="Ready", status="True", reason="r2"))
+    assert conds[0].last_transition_time == t0
+    assert conds[0].reason == "r2"
+    conds = set_condition(conds, Condition(type="Ready", status="False"))
+    assert len(conds) == 1
+
+
+def test_sanitize_name_long():
+    long = "a" * 80
+    s = sanitize_name(long)
+    assert len(s) <= 63
+    assert s != sanitize_name("b" * 80)
