@@ -16,7 +16,6 @@ from ..apimachinery import (
     default_scheme,
     jfield,
 )
-from ..apimachinery.labels import LabelSelector
 
 
 @dataclass

@@ -3,7 +3,7 @@ reference odh controllers/notebook_network.go:132-211)."""
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from ..apimachinery import KubeObject, KubeModel, default_scheme
 from ..apimachinery.labels import LabelSelector
@@ -17,8 +17,8 @@ class NetworkPolicyPort(KubeModel):
 
 @dataclass
 class NetworkPolicyPeer(KubeModel):
-    pod_selector: LabelSelector = None  # type: ignore[assignment]
-    namespace_selector: LabelSelector = None  # type: ignore[assignment]
+    pod_selector: Optional[LabelSelector] = None
+    namespace_selector: Optional[LabelSelector] = None
     ip_block: Dict[str, Any] = field(default_factory=dict)
 
 

@@ -7,8 +7,6 @@ is an apiVersion rewrite with a lossless round-trip through the JSON form.
 """
 from __future__ import annotations
 
-from typing import Dict
-
 from ...apimachinery import default_scheme
 from .v1beta1 import API_VERSION as HUB_API_VERSION
 from .v1beta1 import KIND, Notebook

@@ -90,6 +90,7 @@ def _dataclass_to_dict(obj: Any) -> Dict[str, Any]:
     if extra:
         out.update(copy.deepcopy(extra))
     optional = _optional_fields(cls)
+    json_names = _json_names(cls)
     for f in dataclasses.fields(cls):
         v = getattr(obj, f.name)
         if v is None:
@@ -97,7 +98,7 @@ def _dataclass_to_dict(obj: Any) -> Dict[str, Any]:
         is_struct = dataclasses.is_dataclass(v) and not isinstance(v, type)
         if f.name not in optional and not is_struct and _is_empty(v):
             continue
-        out[f.metadata.get("json", snake_to_camel(f.name))] = _serialize_value(v)
+        out[json_names[f.name]] = _serialize_value(v)
     return out
 
 
@@ -146,12 +147,19 @@ def _deserialize_value(hint: Any, v: Any) -> Any:
 def _from_dict(cls: type, data: Dict[str, Any]) -> Any:
     hints = _type_hints(cls)
     json_names = _json_names(cls)
+    optional = _optional_fields(cls)
     kwargs: Dict[str, Any] = {}
     consumed = set()
     for fname, jname in json_names.items():
         if jname in data:
-            kwargs[fname] = _deserialize_value(hints.get(fname, Any), data[jname])
             consumed.add(jname)
+            v = _deserialize_value(hints.get(fname, Any), data[jname])
+            if v is None and fname not in optional:
+                # explicit JSON null on a non-pointer field (kubectl emits
+                # e.g. `creationTimestamp: null`, `labels: null`): fall back
+                # to the field default instead of storing None
+                continue
+            kwargs[fname] = v
     obj = cls(**kwargs)
     extra = {k: copy.deepcopy(v) for k, v in data.items() if k not in consumed}
     if extra and isinstance(obj, KubeModel):

@@ -1,0 +1,11 @@
+from .client import Client, retry_on_conflict
+from .store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AdmissionRequest,
+    Store,
+    Watch,
+    WatchEvent,
+    register_storage_alias,
+)

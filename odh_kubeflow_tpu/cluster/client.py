@@ -1,0 +1,89 @@
+"""Typed client over the Store — the controller-runtime client.Client analog.
+
+Controllers speak typed objects; this layer handles scheme round-trips and
+provides retry_on_conflict (the retry.RetryOnConflict pattern the reference
+uses at every multi-writer annotation/finalizer site, e.g.
+culling_controller.go:171, odh notebook_controller.go:269)."""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Type, TypeVar
+
+from ..apimachinery import ConflictError, KubeObject, Scheme, default_scheme
+from .store import Store
+
+T = TypeVar("T", bound=KubeObject)
+
+
+class Client:
+    def __init__(self, store: Store, scheme: Scheme = default_scheme):
+        self.store = store
+        self.scheme = scheme
+
+    # -- helpers --
+    def _av_kind(self, cls: Type[KubeObject]) -> tuple:
+        gvk = self.scheme.gvk_for(cls)
+        return gvk.api_version, gvk.kind
+
+    def _prepare(self, obj: KubeObject) -> dict:
+        self.scheme.fill_type_meta(obj)
+        return obj.to_dict()
+
+    def _decode(self, cls: Type[T], data: dict) -> T:
+        return cls.from_dict(data)  # type: ignore[return-value]
+
+    # -- CRUD --
+    def create(self, obj: T) -> T:
+        out = self.store.create_raw(self._prepare(obj))
+        return self._decode(type(obj), out)
+
+    def get(self, cls: Type[T], namespace: str, name: str) -> T:
+        av, kind = self._av_kind(cls)
+        return self._decode(cls, self.store.get_raw(av, kind, namespace, name))
+
+    def list(
+        self,
+        cls: Type[T],
+        namespace: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> List[T]:
+        av, kind = self._av_kind(cls)
+        return [
+            self._decode(cls, d)
+            for d in self.store.list_raw(av, kind, namespace=namespace, label_selector=labels)
+        ]
+
+    def update(self, obj: T) -> T:
+        out = self.store.update_raw(self._prepare(obj))
+        return self._decode(type(obj), out)
+
+    def update_status(self, obj: T) -> T:
+        out = self.store.update_raw(self._prepare(obj), subresource="status")
+        return self._decode(type(obj), out)
+
+    def patch(self, cls: Type[T], namespace: str, name: str, patch: dict) -> T:
+        av, kind = self._av_kind(cls)
+        return self._decode(cls, self.store.patch_raw(av, kind, namespace, name, patch))
+
+    def delete(self, cls: Type[KubeObject], namespace: str, name: str) -> None:
+        av, kind = self._av_kind(cls)
+        self.store.delete_raw(av, kind, namespace, name)
+
+
+def retry_on_conflict(
+    fn: Callable[[], T],
+    steps: int = 5,
+    base_delay: float = 0.01,
+    factor: float = 2.0,
+) -> T:
+    """Run fn until it stops raising ConflictError (fn must re-GET each try)."""
+    delay = base_delay
+    for i in range(steps):
+        try:
+            return fn()
+        except ConflictError:
+            if i == steps - 1:
+                raise
+            time.sleep(delay)
+            delay *= factor
+    raise AssertionError("unreachable")

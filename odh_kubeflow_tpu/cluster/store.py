@@ -1,0 +1,403 @@
+"""In-process API server: the storage + watch + admission core.
+
+This is the build's answer to kube-apiserver/etcd *and* to the reference's
+envtest fixture (reference odh controllers/suite_test.go:91-275 boots a real
+kube-apiserver; here the control plane itself is in-process). Semantics kept
+faithful where the controllers depend on them:
+
+- optimistic concurrency: update with a stale resourceVersion raises
+  ConflictError (drives every retry_on_conflict site),
+- finalizers: delete on a finalized object only sets deletionTimestamp;
+  removal happens when the last finalizer is gone,
+- admission: mutating webhook chain runs on CREATE/UPDATE before persistence,
+  failurePolicy=Fail (exceptions reject the write),
+- status is a subresource: spec writes don't clobber status and vice versa,
+- watches: every subscriber sees ADDED/MODIFIED/DELETED in order,
+- owner-reference GC: cascading (background-style) deletion of dependents.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Type
+
+from ..apimachinery import (
+    AdmissionDeniedError,
+    AlreadyExistsError,
+    ConflictError,
+    InvalidError,
+    KubeObject,
+    NotFoundError,
+    Scheme,
+    default_scheme,
+    json_merge_patch,
+    match_labels,
+    now_rfc3339,
+)
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+# kinds whose GVK groups several served versions onto one storage key
+_STORAGE_KEY_OVERRIDES: Dict[Tuple[str, str], Tuple[str, str]] = {}
+
+
+def register_storage_alias(served_api_version: str, kind: str, storage_api_version: str) -> None:
+    """Serve `served_api_version/kind` from the storage of `storage_api_version/kind`
+    (the conversion-webhook analog for our multi-version Notebook CRD)."""
+    _STORAGE_KEY_OVERRIDES[(served_api_version, kind)] = (storage_api_version, kind)
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: Dict[str, Any]  # canonical JSON form at (or before, for DELETED) the event
+
+    def decode(self, scheme: Scheme = default_scheme) -> KubeObject:
+        return scheme.decode(self.object)
+
+
+@dataclass
+class AdmissionRequest:
+    operation: str  # CREATE | UPDATE
+    object: Dict[str, Any]  # mutable: webhooks edit in place or return a new dict
+    old_object: Optional[Dict[str, Any]] = None
+    dry_run: bool = False
+
+
+AdmissionHandler = Callable[[AdmissionRequest], Optional[Dict[str, Any]]]
+
+
+@dataclass
+class _WebhookRegistration:
+    name: str
+    api_version: str
+    kind: str
+    operations: Tuple[str, ...]
+    handler: AdmissionHandler
+
+
+class Watch:
+    """A subscription to store changes. Iterate or poll with get()."""
+
+    def __init__(
+        self,
+        q: "queue.Queue[Optional[WatchEvent]]",
+        cancel: Callable[[], None],
+        namespace: Optional[str] = None,
+    ):
+        self._q = q
+        self._cancel = cancel
+        self._namespace = namespace
+        self.pending: List[WatchEvent] = []  # initial-list synthetic ADDEDs
+
+    def _admit(self, ev: Optional[WatchEvent]) -> bool:
+        if ev is None or self._namespace is None:
+            return True
+        return ev.object.get("metadata", {}).get("namespace", "") == self._namespace
+
+    def get(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        if self.pending:
+            return self.pending.pop(0)
+        while True:
+            try:
+                ev = self._q.get(timeout=timeout)
+            except queue.Empty:
+                return None
+            if self._admit(ev):
+                return ev
+
+    def stop(self) -> None:
+        self._cancel()
+        self._q.put(None)
+
+    def __iter__(self):
+        while True:
+            ev = self.get()
+            if ev is None:
+                return
+            yield ev
+
+
+class Store:
+    """The versioned object store. Keys: (storage_api_version, kind) -> {ns/name -> dict}."""
+
+    def __init__(self, scheme: Scheme = default_scheme):
+        self.scheme = scheme
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._objects: Dict[Tuple[str, str], Dict[str, Dict[str, Any]]] = {}
+        self._watchers: Dict[Tuple[str, str], List[queue.Queue]] = {}
+        self._webhooks: List[_WebhookRegistration] = []
+        self._gc_enabled = True
+
+    # ---------- helpers ----------
+
+    def _storage_key(self, api_version: str, kind: str) -> Tuple[str, str]:
+        return _STORAGE_KEY_OVERRIDES.get((api_version, kind), (api_version, kind))
+
+    def _bucket(self, api_version: str, kind: str) -> Dict[str, Dict[str, Any]]:
+        return self._objects.setdefault(self._storage_key(api_version, kind), {})
+
+    @staticmethod
+    def _obj_key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}" if namespace else name
+
+    def _next_rv(self) -> str:
+        return str(next(self._rv))
+
+    def _emit(self, api_version: str, kind: str, ev: WatchEvent) -> None:
+        for q in self._watchers.get(self._storage_key(api_version, kind), []):
+            q.put(ev)
+
+    def _run_admission(self, req: AdmissionRequest) -> Dict[str, Any]:
+        obj = req.object
+        av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
+        skey = self._storage_key(av, kind)
+        for wh in self._webhooks:
+            if self._storage_key(wh.api_version, wh.kind) != skey:
+                continue
+            if req.operation not in wh.operations:
+                continue
+            req.object = obj
+            result = wh.handler(req)
+            if result is not None:
+                obj = result
+        return obj
+
+    # ---------- admission registration ----------
+
+    def register_webhook(
+        self,
+        name: str,
+        api_version: str,
+        kind: str,
+        operations: Iterable[str],
+        handler: AdmissionHandler,
+    ) -> None:
+        with self._lock:
+            self._webhooks.append(
+                _WebhookRegistration(name, api_version, kind, tuple(operations), handler)
+            )
+
+    # ---------- CRUD (dict-level; the typed client wraps these) ----------
+
+    def create_raw(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        obj = copy.deepcopy(obj)
+        av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
+        if not av or not kind:
+            raise InvalidError("object missing apiVersion/kind")
+        with self._lock:
+            obj = self._run_admission(AdmissionRequest(operation="CREATE", object=obj))
+            meta = obj.setdefault("metadata", {})
+            name = meta.get("name", "")
+            if not name:
+                gen = meta.get("generateName", "")
+                if not gen:
+                    raise InvalidError("metadata.name or generateName required")
+                name = gen + uuid.uuid4().hex[:5]
+                meta["name"] = name
+            ns = meta.get("namespace", "")
+            bucket = self._bucket(av, kind)
+            key = self._obj_key(ns, name)
+            if key in bucket:
+                raise AlreadyExistsError(kind=kind, name=key)
+            meta["uid"] = meta.get("uid") or str(uuid.uuid4())
+            meta["resourceVersion"] = self._next_rv()
+            meta["generation"] = 1
+            meta["creationTimestamp"] = now_rfc3339()
+            meta.pop("deletionTimestamp", None)
+            bucket[key] = copy.deepcopy(obj)
+            self._emit(av, kind, WatchEvent(ADDED, copy.deepcopy(obj)))
+            return copy.deepcopy(obj)
+
+    def get_raw(self, api_version: str, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        with self._lock:
+            bucket = self._bucket(api_version, kind)
+            key = self._obj_key(namespace, name)
+            if key not in bucket:
+                raise NotFoundError(kind=kind, name=key)
+            return copy.deepcopy(bucket[key])
+
+    def list_raw(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for key, obj in self._bucket(api_version, kind).items():
+                meta = obj.get("metadata", {})
+                if namespace is not None and meta.get("namespace", "") != namespace:
+                    continue
+                if not match_labels(label_selector, meta.get("labels")):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
+            return out
+
+    def update_raw(self, obj: Dict[str, Any], subresource: str = "") -> Dict[str, Any]:
+        obj = copy.deepcopy(obj)
+        av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
+        meta = obj.get("metadata", {})
+        ns, name = meta.get("namespace", ""), meta.get("name", "")
+        with self._lock:
+            bucket = self._bucket(av, kind)
+            key = self._obj_key(ns, name)
+            if key not in bucket:
+                raise NotFoundError(kind=kind, name=key)
+            current = bucket[key]
+            cur_meta = current["metadata"]
+            if meta.get("resourceVersion") and meta["resourceVersion"] != cur_meta["resourceVersion"]:
+                raise ConflictError(
+                    f"Operation cannot be fulfilled on {kind} {key!r}: "
+                    f"the object has been modified"
+                )
+            if subresource == "status":
+                merged = copy.deepcopy(current)
+                if "status" in obj:
+                    merged["status"] = obj["status"]
+                else:
+                    merged.pop("status", None)
+            else:
+                merged = obj
+                # status is a subresource: plain updates cannot change it
+                if "status" in current:
+                    merged["status"] = copy.deepcopy(current["status"])
+                else:
+                    merged.pop("status", None)
+                merged = self._run_admission(
+                    AdmissionRequest(
+                        operation="UPDATE", object=merged, old_object=copy.deepcopy(current)
+                    )
+                )
+            mmeta = merged.setdefault("metadata", {})
+            # immutable fields
+            for f in ("uid", "creationTimestamp", "name", "namespace"):
+                if cur_meta.get(f):
+                    mmeta[f] = cur_meta[f]
+            if cur_meta.get("deletionTimestamp"):
+                mmeta["deletionTimestamp"] = cur_meta["deletionTimestamp"]
+            mmeta["resourceVersion"] = self._next_rv()
+            gen = cur_meta.get("generation", 1)
+            if subresource != "status" and json.dumps(
+                merged.get("spec"), sort_keys=True
+            ) != json.dumps(current.get("spec"), sort_keys=True):
+                gen += 1
+            mmeta["generation"] = gen
+            bucket[key] = copy.deepcopy(merged)
+            self._emit(av, kind, WatchEvent(MODIFIED, copy.deepcopy(merged)))
+            self._finalize_if_ready(av, kind, bucket, key)
+            return copy.deepcopy(bucket.get(key, merged))
+
+    def patch_raw(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str,
+        name: str,
+        patch: Dict[str, Any],
+        subresource: str = "",
+    ) -> Dict[str, Any]:
+        """RFC 7386 merge patch; no resourceVersion precondition (like kubectl patch)."""
+        with self._lock:
+            current = self.get_raw(api_version, kind, namespace, name)
+            patched = json_merge_patch(current, patch)
+            # patches can't change identity
+            patched["apiVersion"], patched["kind"] = current["apiVersion"], current["kind"]
+            pmeta = patched.setdefault("metadata", {})
+            pmeta["name"], pmeta["namespace"] = name, namespace or pmeta.get("namespace", "")
+            pmeta["resourceVersion"] = current["metadata"]["resourceVersion"]
+            return self.update_raw(patched, subresource=subresource)
+
+    def delete_raw(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            bucket = self._bucket(api_version, kind)
+            key = self._obj_key(namespace, name)
+            if key not in bucket:
+                raise NotFoundError(kind=kind, name=key)
+            obj = bucket[key]
+            meta = obj["metadata"]
+            if meta.get("finalizers"):
+                if not meta.get("deletionTimestamp"):
+                    meta["deletionTimestamp"] = now_rfc3339()
+                    meta["resourceVersion"] = self._next_rv()
+                    self._emit(api_version, kind, WatchEvent(MODIFIED, copy.deepcopy(obj)))
+                return
+            self._remove(api_version, kind, bucket, key)
+
+    def _finalize_if_ready(
+        self, api_version: str, kind: str, bucket: Dict[str, Dict[str, Any]], key: str
+    ) -> None:
+        """If deletionTimestamp is set and finalizers are now empty, remove."""
+        obj = bucket.get(key)
+        if obj is None:
+            return
+        meta = obj["metadata"]
+        if meta.get("deletionTimestamp") and not meta.get("finalizers"):
+            self._remove(api_version, kind, bucket, key)
+
+    def _remove(
+        self, api_version: str, kind: str, bucket: Dict[str, Dict[str, Any]], key: str
+    ) -> None:
+        obj = bucket.pop(key)
+        self._emit(api_version, kind, WatchEvent(DELETED, copy.deepcopy(obj)))
+        if self._gc_enabled:
+            self._cascade_delete(obj)
+
+    def _cascade_delete(self, owner: Dict[str, Any]) -> None:
+        """Owner-reference garbage collection (synchronous cascade for
+        determinism — semantics of k8s background GC)."""
+        owner_uid = owner["metadata"].get("uid")
+        if not owner_uid:
+            return
+        victims: List[Tuple[str, str, str, str]] = []
+        for (av, kind), bucket in self._objects.items():
+            for obj in bucket.values():
+                for ref in obj["metadata"].get("ownerReferences", []):
+                    if ref.get("uid") == owner_uid:
+                        m = obj["metadata"]
+                        victims.append((av, kind, m.get("namespace", ""), m["name"]))
+                        break
+        for av, kind, ns, name in victims:
+            try:
+                self.delete_raw(av, kind, ns, name)
+            except NotFoundError:
+                pass
+
+    # ---------- watches ----------
+
+    def watch(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        send_initial: bool = True,
+    ) -> Watch:
+        """Subscribe; atomically delivers synthetic ADDEDs for the current
+        state first (list+watch without a gap, which is what informers need)."""
+        q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
+        skey = self._storage_key(api_version, kind)
+        with self._lock:
+            self._watchers.setdefault(skey, []).append(q)
+
+            def cancel() -> None:
+                with self._lock:
+                    try:
+                        self._watchers[skey].remove(q)
+                    except ValueError:
+                        pass
+
+            w = Watch(q, cancel, namespace=namespace)
+            if send_initial:
+                for obj in self.list_raw(api_version, kind, namespace=namespace):
+                    w.pending.append(WatchEvent(ADDED, obj))
+        return w

@@ -1,0 +1,6 @@
+from .builder import Builder, Mapper, Predicate
+from .controller import Controller, Reconciler, Request, Result
+from .informer import Informer, InformerRegistry
+from .manager import LeaderElector, Manager
+from .metrics import Counter, Gauge, Histogram, Registry, global_registry
+from .workqueue import RateLimiter, WorkQueue

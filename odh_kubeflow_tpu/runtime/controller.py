@@ -1,0 +1,115 @@
+"""Controller: reconcile dispatch with per-key single-flight, error backoff,
+and RequeueAfter — the controller-runtime contract the reference's reconcilers
+are written against (SURVEY §3.2/§3.3)."""
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from .workqueue import RateLimiter, WorkQueue
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: str
+    name: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}" if self.namespace else self.name
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+Reconciler = Callable[[Request], Optional[Result]]
+
+
+class Controller:
+    def __init__(
+        self,
+        name: str,
+        reconciler: Reconciler,
+        workers: int = 1,
+        max_retries: Optional[int] = None,
+    ):
+        self.name = name
+        self.reconciler = reconciler
+        self.workers = workers
+        self.max_retries = max_retries
+        self.queue: WorkQueue[Request] = WorkQueue()
+        self.rate_limiter = RateLimiter()
+        self._threads: List[threading.Thread] = []
+        self._stopped = threading.Event()
+        # counters for observability/tests
+        self.reconcile_count = 0
+        self.error_count = 0
+
+    def enqueue(self, namespace: str, name: str) -> None:
+        self.queue.add(Request(namespace=namespace, name=name))
+
+    def enqueue_after(self, namespace: str, name: str, delay: float) -> None:
+        self.queue.add_after(Request(namespace=namespace, name=name), delay)
+
+    def start(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._worker, name=f"{self.name}-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.queue.shutdown()
+
+    def _worker(self) -> None:
+        while not self._stopped.is_set():
+            req = self.queue.get()
+            if req is None:
+                return
+            try:
+                result = self.reconciler(req)
+                self.reconcile_count += 1
+                self.rate_limiter.forget(req)
+                if result is not None:
+                    if result.requeue_after > 0:
+                        self.queue.add_after(req, result.requeue_after)
+                    elif result.requeue:
+                        self.queue.add_after(req, self.rate_limiter.when(req))
+            except Exception:
+                self.error_count += 1
+                log.error(
+                    "reconciler %s failed for %s:\n%s",
+                    self.name,
+                    req.key,
+                    traceback.format_exc(),
+                )
+                if (
+                    self.max_retries is None
+                    or self.rate_limiter.retries(req) < self.max_retries
+                ):
+                    self.queue.add_after(req, self.rate_limiter.when(req))
+            finally:
+                self.queue.done(req)
+
+    def wait_idle(self, timeout: float = 10.0, settle: float = 0.05) -> bool:
+        """Test helper: wait until the queue is empty and stays empty briefly."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if len(self.queue) == 0 and not self.queue._processing:
+                time.sleep(settle)
+                if len(self.queue) == 0 and not self.queue._processing:
+                    return True
+            time.sleep(0.01)
+        return False

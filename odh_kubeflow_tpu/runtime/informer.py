@@ -1,0 +1,131 @@
+"""Shared informers: one watch per kind, an in-memory cache, and fan-out to
+event handlers. This is the informer/cache layer controller-runtime gives the
+reference for free; reads in our controllers go through the cache just like
+the reference's mgr.GetClient() reads (with the same staleness caveats)."""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..apimachinery import Scheme, default_scheme
+from ..cluster.store import ADDED, DELETED, MODIFIED, Store, WatchEvent
+
+# handler(event_type, obj_dict, old_obj_dict_or_None)
+EventHandler = Callable[[str, dict, Optional[dict]], None]
+
+
+class Informer:
+    def __init__(self, store: Store, api_version: str, kind: str):
+        self.store = store
+        self.api_version = api_version
+        self.kind = kind
+        self._cache: Dict[str, dict] = {}
+        self._handlers: List[EventHandler] = []
+        self._lock = threading.RLock()
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+        self.synced = threading.Event()
+
+    def add_handler(self, handler: EventHandler) -> None:
+        with self._lock:
+            self._handlers.append(handler)
+            # late registrants see the current state as synthetic ADDs
+            for obj in self._cache.values():
+                handler(ADDED, obj, None)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._watch = self.store.watch(self.api_version, self.kind)
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self.kind}", daemon=True
+        )
+        self._thread.start()
+
+    def _key(self, obj: dict) -> str:
+        m = obj.get("metadata", {})
+        ns = m.get("namespace", "")
+        return f"{ns}/{m.get('name', '')}" if ns else m.get("name", "")
+
+    def _run(self) -> None:
+        assert self._watch is not None
+        # drain the initial synthetic ADDs, then mark synced
+        while self._watch.pending:
+            self._dispatch(self._watch.pending.pop(0))
+        self.synced.set()
+        for ev in self._watch:
+            if self._stopped.is_set():
+                return
+            self._dispatch(ev)
+
+    def _dispatch(self, ev: WatchEvent) -> None:
+        key = self._key(ev.object)
+        with self._lock:
+            old = self._cache.get(key)
+            if ev.type == DELETED:
+                self._cache.pop(key, None)
+            else:
+                self._cache[key] = ev.object
+            handlers = list(self._handlers)
+        for h in handlers:
+            try:
+                h(ev.type, ev.object, old)
+            except Exception:  # handler bugs must not kill the watch loop
+                import traceback
+
+                traceback.print_exc()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._watch is not None:
+            self._watch.stop()
+
+    # -- cache reads --
+    def get(self, namespace: str, name: str) -> Optional[dict]:
+        key = f"{namespace}/{name}" if namespace else name
+        with self._lock:
+            obj = self._cache.get(key)
+            return dict(obj) if obj else None
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return list(self._cache.values())
+
+
+class InformerRegistry:
+    def __init__(self, store: Store, scheme: Scheme = default_scheme):
+        self.store = store
+        self.scheme = scheme
+        self._informers: Dict[Tuple[str, str], Informer] = {}
+        self._lock = threading.Lock()
+        self._started = False
+
+    def informer_for(self, cls_or_gvk) -> Informer:
+        if isinstance(cls_or_gvk, tuple):
+            av, kind = cls_or_gvk
+        else:
+            gvk = self.scheme.gvk_for(cls_or_gvk)
+            av, kind = gvk.api_version, gvk.kind
+        with self._lock:
+            inf = self._informers.get((av, kind))
+            if inf is None:
+                inf = Informer(self.store, av, kind)
+                self._informers[(av, kind)] = inf
+                if self._started:
+                    inf.start()
+            return inf
+
+    def start_all(self) -> None:
+        with self._lock:
+            self._started = True
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+        for inf in informers:
+            inf.synced.wait(timeout=5)
+
+    def stop_all(self) -> None:
+        with self._lock:
+            for inf in self._informers.values():
+                inf.stop()

@@ -1,0 +1,178 @@
+"""Manager: owns the client, informers, controllers, webhook registrations,
+leader election, and health/metrics — ctrl.NewManager + mgr.Start() analog
+(reference notebook-controller/main.go:87-148, odh main.go:117-245)."""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, List, Optional
+
+from ..api.coordination import Lease, LeaseSpec
+from ..apimachinery import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    Scheme,
+    default_scheme,
+    now_rfc3339,
+    parse_time,
+)
+from ..cluster.client import Client
+from ..cluster.store import Store
+from .builder import Builder
+from .controller import Controller
+from .informer import InformerRegistry
+from .metrics import Registry, global_registry
+
+log = logging.getLogger(__name__)
+
+
+class LeaderElector:
+    """Lease-based leader election with the standard acquire/renew loop."""
+
+    def __init__(
+        self,
+        client: Client,
+        lease_name: str,
+        namespace: str = "kube-system",
+        identity: Optional[str] = None,
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
+    ):
+        self.client = client
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or f"mgr-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.is_leader = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True, name="leader-elector")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _try_acquire(self) -> bool:
+        try:
+            lease = self.client.get(Lease, self.namespace, self.lease_name)
+        except NotFoundError:
+            lease = Lease()
+            lease.metadata.name = self.lease_name
+            lease.metadata.namespace = self.namespace
+            lease.spec = LeaseSpec(
+                holder_identity=self.identity,
+                lease_duration_seconds=int(self.lease_duration),
+                acquire_time=now_rfc3339(),
+                renew_time=now_rfc3339(),
+            )
+            try:
+                self.client.create(lease)
+                return True
+            except AlreadyExistsError:
+                return False
+        if lease.spec.holder_identity == self.identity:
+            lease.spec.renew_time = now_rfc3339()
+        else:
+            if lease.spec.renew_time:
+                age = time.time() - parse_time(lease.spec.renew_time).timestamp()
+                if age < (lease.spec.lease_duration_seconds or self.lease_duration):
+                    return False  # healthy other leader
+            lease.spec.holder_identity = self.identity
+            lease.spec.acquire_time = now_rfc3339()
+            lease.spec.renew_time = now_rfc3339()
+            lease.spec.lease_transitions += 1
+        try:
+            self.client.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._try_acquire():
+                self.is_leader.set()
+            else:
+                self.is_leader.clear()
+            self._stop.wait(self.renew_period)
+
+
+class Manager:
+    def __init__(
+        self,
+        store: Store,
+        scheme: Scheme = default_scheme,
+        leader_election: bool = False,
+        leader_election_id: str = "tpu-notebook-controller",
+        metrics_registry: Optional[Registry] = None,
+    ):
+        self.store = store
+        self.scheme = scheme
+        self.client = Client(store, scheme)
+        self.informers = InformerRegistry(store, scheme)
+        self.metrics = metrics_registry or global_registry
+        self.controllers: List[Controller] = []
+        self._runnables: List[Callable[[], None]] = []  # extra start hooks
+        self._started = False
+        self.elector: Optional[LeaderElector] = None
+        if leader_election:
+            self.elector = LeaderElector(self.client, leader_election_id)
+
+    def builder(self, name: str) -> Builder:
+        return Builder(self, name)
+
+    def add_controller(self, ctrl: Controller) -> None:
+        self.controllers.append(ctrl)
+        if self._started:
+            ctrl.start()
+
+    def add_runnable(self, fn: Callable[[], None]) -> None:
+        self._runnables.append(fn)
+
+    def start(self, wait_for_leadership_timeout: float = 10.0) -> None:
+        if self._started:
+            return
+        if self.elector is not None:
+            self.elector.start()
+            if not self.elector.is_leader.wait(timeout=wait_for_leadership_timeout):
+                raise TimeoutError("failed to acquire leadership")
+        self.informers.start_all()
+        for ctrl in self.controllers:
+            ctrl.start()
+        for fn in self._runnables:
+            fn()
+        self._started = True
+
+    def stop(self) -> None:
+        for ctrl in self.controllers:
+            ctrl.stop()
+        self.informers.stop_all()
+        if self.elector is not None:
+            self.elector.stop()
+        self._started = False
+
+    # health endpoints contract (healthz/readyz — both reference main.go files)
+    def healthz(self) -> bool:
+        return True
+
+    def readyz(self) -> bool:
+        return self._started
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        """Test/bench helper: wait for every controller queue to drain."""
+        deadline = time.monotonic() + timeout
+        for ctrl in self.controllers:
+            remaining = max(0.1, deadline - time.monotonic())
+            if not ctrl.wait_idle(timeout=remaining):
+                return False
+        # second pass: controller A's work may have re-fed controller B
+        for ctrl in self.controllers:
+            remaining = max(0.1, deadline - time.monotonic())
+            if not ctrl.wait_idle(timeout=remaining):
+                return False
+        return True

@@ -1,0 +1,165 @@
+"""Prometheus-style metrics registry, from scratch.
+
+Counter/Gauge/Histogram with labels + collector callbacks (the reference's
+custom collector lists StatefulSets at scrape time — pkg/metrics/metrics.go:82-99;
+collector callbacks reproduce that pull-at-scrape pattern), rendered in the
+Prometheus text exposition format."""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        return tuple(labels.get(k, "") for k in self.label_names)
+
+    def labels_str(self, key: Tuple[str, ...]) -> str:
+        if not self.label_names:
+            return ""
+        pairs = ",".join(f'{k}="{v}"' for k, v in zip(self.label_names, key))
+        return "{" + pairs + "}"
+
+
+class Counter(_Metric):
+    type_name = "counter"
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    type_name = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    type_name = "histogram"
+    DEFAULT_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300)
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        label_names: Sequence[str] = (),
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[Tuple[str, ...], List[int]] = {}
+        self._sums: Dict[Tuple[str, ...], float] = {}
+        self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        with self._lock:
+            k = self._key(labels)
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def percentile(self, p: float, **labels: str) -> Optional[float]:
+        """Approximate percentile from bucket counts (upper bound of the bucket)."""
+        with self._lock:
+            k = self._key(labels)
+            total = self._totals.get(k, 0)
+            if total == 0:
+                return None
+            target = p * total
+            counts = self._counts[k]
+            for i, b in enumerate(self.buckets):
+                if counts[i] >= target:
+                    return b
+            return self.buckets[-1]
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], None]] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "", labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(name, help_, labels))
+
+    def gauge(self, name: str, help_: str = "", labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help_, labels))
+
+    def histogram(
+        self,
+        name: str,
+        help_: str = "",
+        labels: Sequence[str] = (),
+        buckets: Sequence[float] = Histogram.DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._register(Histogram(name, help_, labels, buckets))
+
+    def _register(self, m: _Metric) -> "_Metric":
+        with self._lock:
+            existing = self._metrics.get(m.name)
+            if existing is not None:
+                return existing  # idempotent re-registration
+            self._metrics[m.name] = m
+            return m
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        """fn runs at scrape time and may .set() gauges (pull-style collector)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def render(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+            metrics = list(self._metrics.values())
+        for fn in collectors:
+            fn()
+        lines: List[str] = []
+        for m in metrics:
+            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.type_name}")
+            if isinstance(m, Histogram):
+                with m._lock:
+                    for k, counts in m._counts.items():
+                        cumulative_labels = m.labels_str(k)
+                        for b, c in zip(m.buckets, counts):
+                            le = ("{" + cumulative_labels[1:-1] + f',le="{b}"' + "}") if cumulative_labels else f'{{le="{b}"}}'
+                            lines.append(f"{m.name}_bucket{le} {c}")
+                        lines.append(f"{m.name}_sum{cumulative_labels} {m._sums[k]}")
+                        lines.append(f"{m.name}_count{cumulative_labels} {m._totals[k]}")
+            else:
+                with m._lock:
+                    if not m._values and not m.label_names:
+                        lines.append(f"{m.name} 0")
+                    for k, v in sorted(m._values.items()):
+                        lines.append(f"{m.name}{m.labels_str(k)} {v}")
+        return "\n".join(lines) + "\n"
+
+
+global_registry = Registry()

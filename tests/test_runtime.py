@@ -1,0 +1,170 @@
+"""Controller runtime: workqueue semantics, informer fan-out, builder wiring,
+manager lifecycle, leader election."""
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.apps import StatefulSet
+from odh_kubeflow_tpu.api.core import ConfigMap, Pod
+from odh_kubeflow_tpu.api.notebook import Notebook
+from odh_kubeflow_tpu.cluster import Client, Store
+from odh_kubeflow_tpu.runtime import Manager, Request, Result, WorkQueue
+from odh_kubeflow_tpu.runtime.manager import LeaderElector
+
+
+def test_workqueue_dedup_and_singleflight():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+    a = q.get()
+    q.add(a)  # re-add while processing -> dirty, not queued
+    assert len(q) == 1
+    q.done(a)  # dirty -> requeued
+    got = {q.get(), q.get()}
+    assert got == {"a", "b"}
+
+
+def test_workqueue_add_after():
+    q = WorkQueue()
+    t0 = time.monotonic()
+    q.add_after("x", 0.15)
+    assert q.get(timeout=0.05) is None
+    got = q.get(timeout=2)
+    assert got == "x"
+    assert time.monotonic() - t0 >= 0.14
+
+
+def mk_nb(name, ns="user"):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    return nb
+
+
+def test_builder_for_owns_watches():
+    store = Store()
+    client = Client(store)
+    mgr = Manager(store)
+    seen = []
+    done = threading.Event()
+
+    def reconcile(req: Request):
+        seen.append(req.key)
+        done.set()
+        return None
+
+    def map_pod(obj):
+        name = obj.get("metadata", {}).get("labels", {}).get("notebook-name")
+        if not name:
+            return []
+        return [(obj["metadata"].get("namespace", ""), name)]
+
+    (
+        mgr.builder("test")
+        .for_(Notebook)
+        .owns(StatefulSet)
+        .watches(Pod, map_pod)
+        .complete(reconcile)
+    )
+    mgr.start()
+    try:
+        client.create(mk_nb("alpha"))
+        assert done.wait(2)
+        mgr.wait_idle()
+        assert "user/alpha" in seen
+
+        # owned STS event maps back to the notebook
+        seen.clear()
+        nb = client.get(Notebook, "user", "alpha")
+        sts = StatefulSet()
+        sts.metadata.name = "alpha"
+        sts.metadata.namespace = "user"
+        sts.set_owner(nb)
+        client.create(sts)
+        mgr.wait_idle()
+        assert "user/alpha" in seen
+
+        # labeled pod maps via the custom mapper
+        seen.clear()
+        pod = Pod()
+        pod.metadata.name = "alpha-0"
+        pod.metadata.namespace = "user"
+        pod.metadata.labels = {"notebook-name": "alpha"}
+        client.create(pod)
+        mgr.wait_idle()
+        assert "user/alpha" in seen
+    finally:
+        mgr.stop()
+
+
+def test_reconcile_error_retries_with_backoff():
+    store = Store()
+    client = Client(store)
+    mgr = Manager(store)
+    calls = []
+    succeeded = threading.Event()
+
+    def flaky(req: Request):
+        calls.append(time.monotonic())
+        if len(calls) < 3:
+            raise RuntimeError("boom")
+        succeeded.set()
+        return None
+
+    mgr.builder("flaky").for_(ConfigMap).complete(flaky)
+    mgr.start()
+    try:
+        cm = ConfigMap()
+        cm.metadata.name = "c"
+        cm.metadata.namespace = "d"
+        client.create(cm)
+        assert succeeded.wait(5)
+        assert len(calls) >= 3
+    finally:
+        mgr.stop()
+
+
+def test_requeue_after():
+    store = Store()
+    client = Client(store)
+    mgr = Manager(store)
+    calls = []
+    twice = threading.Event()
+
+    def periodic(req: Request):
+        calls.append(time.monotonic())
+        if len(calls) >= 2:
+            twice.set()
+            return None
+        return Result(requeue_after=0.1)
+
+    mgr.builder("periodic").for_(ConfigMap).complete(periodic)
+    mgr.start()
+    try:
+        cm = ConfigMap()
+        cm.metadata.name = "p"
+        cm.metadata.namespace = "d"
+        client.create(cm)
+        assert twice.wait(5)
+        assert calls[1] - calls[0] >= 0.09
+    finally:
+        mgr.stop()
+
+
+def test_leader_election_exclusive():
+    store = Store()
+    c1, c2 = Client(store), Client(store)
+    e1 = LeaderElector(c1, "test-lock", identity="one", lease_duration=1.0, renew_period=0.1)
+    e2 = LeaderElector(c2, "test-lock", identity="two", lease_duration=1.0, renew_period=0.1)
+    e1.start()
+    assert e1.is_leader.wait(2)
+    e2.start()
+    time.sleep(0.3)
+    assert not e2.is_leader.is_set()
+    # leader one dies; two takes over after the lease expires
+    e1.stop()
+    assert e2.is_leader.wait(5)
+    e2.stop()

@@ -1,0 +1,232 @@
+"""In-process API server semantics: optimistic concurrency, finalizers,
+status subresource, admission, watches, owner-ref GC."""
+import pytest
+
+from odh_kubeflow_tpu.api.apps import StatefulSet
+from odh_kubeflow_tpu.api.core import ConfigMap, Pod, Service
+from odh_kubeflow_tpu.api.notebook import Notebook
+from odh_kubeflow_tpu.apimachinery import (
+    AdmissionDeniedError,
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+)
+from odh_kubeflow_tpu.cluster import ADDED, DELETED, MODIFIED, Client, Store, retry_on_conflict
+
+
+@pytest.fixture()
+def client():
+    return Client(Store())
+
+
+def mk_cm(name, ns="default", data=None):
+    cm = ConfigMap()
+    cm.metadata.name = name
+    cm.metadata.namespace = ns
+    cm.data = data or {}
+    return cm
+
+
+def test_create_get_roundtrip(client):
+    created = client.create(mk_cm("a", data={"k": "v"}))
+    assert created.metadata.uid and created.metadata.resource_version
+    got = client.get(ConfigMap, "default", "a")
+    assert got.data == {"k": "v"}
+    with pytest.raises(AlreadyExistsError):
+        client.create(mk_cm("a"))
+
+
+def test_generate_name(client):
+    cm = ConfigMap()
+    cm.metadata.generate_name = "nb-"
+    cm.metadata.namespace = "default"
+    created = client.create(cm)
+    assert created.metadata.name.startswith("nb-")
+    assert len(created.metadata.name) > 3
+
+
+def test_update_conflict(client):
+    client.create(mk_cm("a", data={"v": "1"}))
+    c1 = client.get(ConfigMap, "default", "a")
+    c2 = client.get(ConfigMap, "default", "a")
+    c1.data["v"] = "2"
+    client.update(c1)
+    c2.data["v"] = "3"
+    with pytest.raises(ConflictError):
+        client.update(c2)
+
+    # retry_on_conflict resolves it the way the reference does everywhere
+    def attempt():
+        cur = client.get(ConfigMap, "default", "a")
+        cur.data["v"] = "3"
+        return client.update(cur)
+
+    out = retry_on_conflict(attempt)
+    assert out.data["v"] == "3"
+
+
+def test_status_subresource_isolation(client):
+    sts = StatefulSet()
+    sts.metadata.name = "s"
+    sts.metadata.namespace = "default"
+    sts.spec.replicas = 1
+    client.create(sts)
+
+    # status write doesn't clobber spec
+    cur = client.get(StatefulSet, "default", "s")
+    cur.status.ready_replicas = 1
+    client.update_status(cur)
+
+    # spec write doesn't clobber status
+    cur = client.get(StatefulSet, "default", "s")
+    assert cur.status.ready_replicas == 1
+    cur.spec.replicas = 3
+    cur.status.ready_replicas = 99  # must be ignored on plain update
+    client.update(cur)
+    final = client.get(StatefulSet, "default", "s")
+    assert final.spec.replicas == 3
+    assert final.status.ready_replicas == 1
+
+
+def test_generation_bumps_only_on_spec_change(client):
+    sts = StatefulSet()
+    sts.metadata.name = "g"
+    sts.metadata.namespace = "default"
+    sts.spec.replicas = 1
+    client.create(sts)
+    cur = client.get(StatefulSet, "default", "g")
+    assert cur.metadata.generation == 1
+    cur.metadata.labels["x"] = "y"
+    cur = client.update(cur)
+    assert cur.metadata.generation == 1  # metadata-only change
+    cur.spec.replicas = 2
+    cur = client.update(cur)
+    assert cur.metadata.generation == 2
+
+
+def test_finalizer_blocks_deletion(client):
+    cm = mk_cm("fin")
+    cm.metadata.finalizers = ["example.com/cleanup"]
+    client.create(cm)
+    client.delete(ConfigMap, "default", "fin")
+    # still there, terminating
+    got = client.get(ConfigMap, "default", "fin")
+    assert got.metadata.deletion_timestamp
+    # removing the finalizer completes deletion
+    got.metadata.finalizers = []
+    client.update(got)
+    with pytest.raises(NotFoundError):
+        client.get(ConfigMap, "default", "fin")
+
+
+def test_owner_gc_cascade(client):
+    nb = Notebook()
+    nb.metadata.name = "nb"
+    nb.metadata.namespace = "user"
+    nb = client.create(nb)
+    sts = StatefulSet()
+    sts.metadata.name = "nb"
+    sts.metadata.namespace = "user"
+    sts.set_owner(nb)
+    client.create(sts)
+    svc = Service()
+    svc.metadata.name = "nb"
+    svc.metadata.namespace = "user"
+    svc.set_owner(nb)
+    client.create(svc)
+
+    client.delete(Notebook, "user", "nb")
+    with pytest.raises(NotFoundError):
+        client.get(StatefulSet, "user", "nb")
+    with pytest.raises(NotFoundError):
+        client.get(Service, "user", "nb")
+
+
+def test_merge_patch_removes_annotation(client):
+    cm = mk_cm("ann")
+    cm.metadata.annotations = {"kubeflow-resource-stopped": "lock", "keep": "y"}
+    client.create(cm)
+    client.patch(
+        ConfigMap,
+        "default",
+        "ann",
+        {"metadata": {"annotations": {"kubeflow-resource-stopped": None}}},
+    )
+    got = client.get(ConfigMap, "default", "ann")
+    assert "kubeflow-resource-stopped" not in got.metadata.annotations
+    assert got.metadata.annotations.get("keep") == "y"
+
+
+def test_watch_stream_order():
+    store = Store()
+    client = Client(store)
+    w = store.watch("v1", "ConfigMap")
+    client.create(mk_cm("w1"))
+    cur = client.get(ConfigMap, "default", "w1")
+    cur.data["x"] = "1"
+    client.update(cur)
+    client.delete(ConfigMap, "default", "w1")
+    events = [w.get(timeout=1) for _ in range(3)]
+    assert [e.type for e in events] == [ADDED, MODIFIED, DELETED]
+    w.stop()
+
+
+def test_watch_initial_state():
+    store = Store()
+    client = Client(store)
+    client.create(mk_cm("pre"))
+    w = store.watch("v1", "ConfigMap")
+    ev = w.get(timeout=1)
+    assert ev.type == ADDED and ev.object["metadata"]["name"] == "pre"
+    w.stop()
+
+
+def test_mutating_admission_runs_on_create():
+    store = Store()
+    client = Client(store)
+
+    def inject_lock(req):
+        if req.operation == "CREATE":
+            anns = req.object.setdefault("metadata", {}).setdefault("annotations", {})
+            anns["kubeflow-resource-stopped"] = "lock"
+        return req.object
+
+    store.register_webhook(
+        "lock-injector", "kubeflow.org/v1beta1", "Notebook", ["CREATE"], inject_lock
+    )
+    nb = Notebook()
+    nb.metadata.name = "nb"
+    nb.metadata.namespace = "u"
+    created = client.create(nb)
+    assert created.metadata.annotations["kubeflow-resource-stopped"] == "lock"
+
+
+def test_admission_denial_rejects_write():
+    store = Store()
+    client = Client(store)
+
+    def deny(req):
+        raise AdmissionDeniedError("no")
+
+    store.register_webhook("denier", "v1", "ConfigMap", ["CREATE"], deny)
+    with pytest.raises(AdmissionDeniedError):
+        client.create(mk_cm("x"))
+    with pytest.raises(NotFoundError):
+        client.get(ConfigMap, "default", "x")
+
+
+def test_spoke_version_storage_alias():
+    from odh_kubeflow_tpu.cluster import register_storage_alias
+
+    store = Store()
+    register_storage_alias("kubeflow.org/v1", "Notebook", "kubeflow.org/v1beta1")
+    nb_dict = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "u"},
+        "spec": {"template": {"spec": {"containers": []}}},
+    }
+    store.create_raw(nb_dict)
+    # visible through the hub version
+    got = store.get_raw("kubeflow.org/v1beta1", "Notebook", "u", "nb")
+    assert got["metadata"]["name"] == "nb"
