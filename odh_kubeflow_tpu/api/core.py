@@ -137,6 +137,7 @@ class PodSpec(KubeModel):
     enable_service_links: Optional[bool] = None
     restart_policy: str = ""
     scheduler_name: str = ""
+    node_name: str = ""
 
     def container(self, name: str) -> Optional[Container]:
         for c in self.containers:

@@ -9,3 +9,7 @@ from .store import (
     WatchEvent,
     register_storage_alias,
 )
+from .kubelet import Behavior, Kubelet, PodDecision
+from .scheduler import Scheduler
+from .sim import SimCluster
+from .statefulset import StatefulSetController

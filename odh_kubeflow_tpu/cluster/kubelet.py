@@ -1,0 +1,194 @@
+"""Kubelet simulator: brings scheduled pods to life.
+
+The KinD-CI analog (SURVEY §4 tier 4): envtest has no kubelet, so the
+reference can never assert pod behavior in-process — this build can. Pods
+transition Pending -> Running -> Ready under a pluggable PodBehavior, which
+can also start a REAL localhost HTTP server per pod (the in-pod probe agent),
+registered in the cluster DNS so the culling controller's HTTP probes travel
+an actual socket."""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Tuple
+
+from ..api.core import ContainerState, ContainerStatus, Pod
+from ..apimachinery import Condition, ConflictError, NotFoundError, now_rfc3339
+from ..runtime.controller import Request, Result
+from ..runtime.manager import Manager
+
+_ip_seq = itertools.count(2)
+
+
+@dataclass
+class PodDecision:
+    """What the behavior wants for a pod."""
+
+    ready_after: float = 0.0  # seconds of simulated startup
+    fail: str = ""  # nonempty -> container stuck waiting with this reason
+    # start a real server for this pod; returns (host, port) or
+    # (host, port, close_fn) to register in cluster DNS
+    serve: Optional[Callable[[Pod], tuple]] = None
+
+
+# behavior(pod) -> PodDecision; matched first-wins
+Behavior = Callable[[Pod], Optional[PodDecision]]
+
+
+class Kubelet:
+    def __init__(self, manager: Manager):
+        self.manager = manager
+        self.client = manager.client
+        self._behaviors: list[Behavior] = []
+        # pod key -> (pod uid, host, port, close_fn|None); uid detects recreation
+        self._servers: Dict[str, tuple] = {}
+        self._started_at: Dict[str, Tuple[str, float]] = {}  # key -> (uid, t0)
+        self._lock = threading.Lock()
+
+    def add_behavior(self, behavior: Behavior) -> None:
+        with self._lock:
+            self._behaviors.insert(0, behavior)
+
+    def server_for(self, namespace: str, pod_name: str) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            entry = self._servers.get(f"{namespace}/{pod_name}")
+            return (entry[1], entry[2]) if entry else None
+
+    def _drop_state(self, key: str, expect_uid: Optional[str] = None) -> None:
+        """Clear per-pod state (closing any server). With expect_uid, only
+        state belonging to a DIFFERENT uid is cleared (pod recreation)."""
+        with self._lock:
+            entry = self._servers.get(key)
+            if entry and (expect_uid is None or entry[0] != expect_uid):
+                self._servers.pop(key, None)
+                if entry[3] is not None:
+                    try:
+                        entry[3]()
+                    except Exception:
+                        pass
+            started = self._started_at.get(key)
+            if started and (expect_uid is None or started[0] != expect_uid):
+                self._started_at.pop(key, None)
+
+    def shutdown_servers(self) -> None:
+        with self._lock:
+            keys = list(self._servers)
+        for k in keys:
+            self._drop_state(k)
+
+    def setup(self) -> None:
+        (
+            self.manager.builder("kubelet")
+            .for_(Pod, predicate=lambda ev, obj, old: bool(obj.get("spec", {}).get("nodeName")))
+            .complete(self.reconcile)
+        )
+
+    def _decide(self, pod: Pod) -> PodDecision:
+        with self._lock:
+            behaviors = list(self._behaviors)
+        for b in behaviors:
+            d = b(pod)
+            if d is not None:
+                return d
+        return PodDecision()
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        import time
+
+        try:
+            pod = self.client.get(Pod, req.namespace, req.name)
+        except NotFoundError:
+            self._drop_state(req.key)
+            return None
+        # recreated pod (same name, new uid): reset start time / server
+        self._drop_state(req.key, expect_uid=pod.metadata.uid)
+        if not pod.spec.node_name or pod.metadata.deletion_timestamp:
+            return None
+
+        decision = self._decide(pod)
+        key = req.key
+
+        if decision.fail:
+            pod.status.phase = "Pending"
+            pod.status.container_statuses = [
+                ContainerStatus(
+                    name=c.name,
+                    ready=False,
+                    state=ContainerState(
+                        waiting={"reason": decision.fail, "message": decision.fail}
+                    ),
+                )
+                for c in pod.spec.containers
+            ]
+            pod.status.conditions = [
+                Condition(type="PodScheduled", status="True"),
+                Condition(
+                    type="Ready", status="False", reason=decision.fail
+                ),
+            ]
+            self._update_status(pod)
+            return None
+
+        with self._lock:
+            if key not in self._started_at:
+                self._started_at[key] = (pod.metadata.uid, time.monotonic())
+            started = self._started_at[key][1]
+        elapsed = time.monotonic() - started
+        if elapsed < decision.ready_after:
+            if pod.status.phase != "Pending" or not pod.status.container_statuses:
+                pod.status.phase = "Pending"
+                pod.status.container_statuses = [
+                    ContainerStatus(
+                        name=c.name,
+                        ready=False,
+                        state=ContainerState(waiting={"reason": "ContainerCreating"}),
+                    )
+                    for c in pod.spec.containers
+                ]
+                pod.status.conditions = [
+                    Condition(type="PodScheduled", status="True"),
+                    Condition(type="Ready", status="False", reason="ContainersNotReady"),
+                ]
+                self._update_status(pod)
+            return Result(requeue_after=max(0.01, decision.ready_after - elapsed))
+
+        if decision.serve is not None:
+            with self._lock:
+                have_server = key in self._servers
+            if not have_server:
+                result = decision.serve(pod)
+                host, port = result[0], result[1]
+                close = result[2] if len(result) > 2 else None
+                with self._lock:
+                    self._servers[key] = (pod.metadata.uid, host, port, close)
+
+        if pod.status.phase == "Running" and any(
+            c.type == "Ready" and c.status == "True" for c in pod.status.conditions
+        ):
+            return None
+        pod.status.phase = "Running"
+        pod.status.pod_ip = pod.status.pod_ip or f"10.1.{next(_ip_seq) % 250}.{next(_ip_seq) % 250}"
+        pod.status.container_statuses = [
+            ContainerStatus(
+                name=c.name,
+                ready=True,
+                state=ContainerState(running={"startedAt": now_rfc3339()}),
+                image=c.image,
+            )
+            for c in pod.spec.containers
+        ]
+        pod.status.conditions = [
+            Condition(type="PodScheduled", status="True"),
+            Condition(type="Initialized", status="True"),
+            Condition(type="ContainersReady", status="True"),
+            Condition(type="Ready", status="True"),
+        ]
+        self._update_status(pod)
+        return None
+
+    def _update_status(self, pod: Pod) -> None:
+        try:
+            self.client.update_status(pod)
+        except (ConflictError, NotFoundError):
+            pass  # re-reconciled via watch anyway

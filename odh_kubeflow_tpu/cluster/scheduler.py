@@ -1,0 +1,219 @@
+"""TPU-aware scheduler for the in-process cluster.
+
+Models the piece of GKE the north star depends on: TPU slice node pools where
+every multi-host pool IS one ICI slice. Placement rules:
+
+- nodeSelector labels must match the node,
+- `google.com/tpu` requests bind whole hosts (one TPU pod per node),
+- **gang placement**: all pods of a multi-host StatefulSet must land in the
+  SAME node pool (= same ICI slice), all-or-nothing — if the pool can't hold
+  every replica, nothing schedules and an Unschedulable event is emitted
+  (SURVEY §7 hard part (d): scheduling atomicity for multi-host slices),
+- CPU/memory capacity accounting for non-TPU pods.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from ..api.apps import StatefulSet
+from ..api.core import Event, Node, ObjectReference, Pod
+from ..apimachinery import NotFoundError, controller_owner, now_rfc3339
+from ..runtime.controller import Request, Result
+from ..runtime.manager import Manager
+from ..tpu import GKE_NODEPOOL_LABEL, TPU_RESOURCE
+from ..utils import parse_quantity
+
+_event_seq = itertools.count(1)
+
+
+def pod_tpu_request(pod: Pod) -> int:
+    total = 0
+    for c in pod.spec.containers:
+        if c.resources and c.resources.requests.get(TPU_RESOURCE):
+            total += int(parse_quantity(c.resources.requests[TPU_RESOURCE]))
+        elif c.resources and c.resources.limits.get(TPU_RESOURCE):
+            total += int(parse_quantity(c.resources.limits[TPU_RESOURCE]))
+    return total
+
+
+def pod_resource_request(pod: Pod, resource: str) -> float:
+    total = 0.0
+    for c in pod.spec.containers:
+        if c.resources and c.resources.requests.get(resource):
+            total += parse_quantity(c.resources.requests[resource])
+    return total
+
+
+class Scheduler:
+    def __init__(self, manager: Manager):
+        self.manager = manager
+        self.client = manager.client
+
+    def setup(self) -> None:
+        (
+            self.manager.builder("scheduler")
+            .for_(Pod, predicate=lambda ev, obj, old: not obj.get("spec", {}).get("nodeName"))
+            .complete(self.reconcile)
+        )
+
+    # -- capacity --
+    def _assignment_map(self) -> Dict[str, List[Pod]]:
+        """node name -> assigned pods, built once per scheduling pass."""
+        out: Dict[str, List[Pod]] = {}
+        for p in self.client.list(Pod):
+            if p.spec.node_name and not p.metadata.deletion_timestamp:
+                out.setdefault(p.spec.node_name, []).append(p)
+        return out
+
+    def _node_free(
+        self, node: Node, pod: Pod, tpu_chips: int, assignment: Dict[str, List[Pod]]
+    ) -> bool:
+        assigned = assignment.get(node.metadata.name, [])
+        if tpu_chips > 0:
+            cap = int(parse_quantity(node.status.allocatable.get(TPU_RESOURCE, "0")))
+            if cap < tpu_chips:
+                return False
+            # TPU hosts are exclusively bound: one TPU workload pod per node
+            if any(pod_tpu_request(p) > 0 for p in assigned):
+                return False
+            return True
+        for resource in ("cpu", "memory"):
+            want = pod_resource_request(pod, resource)
+            if want == 0:
+                continue
+            cap = parse_quantity(node.status.allocatable.get(resource, "0"))
+            used = sum(pod_resource_request(p, resource) for p in assigned)
+            if used + want > cap:
+                return False
+        return True
+
+    def _selector_matches(self, pod: Pod, node: Node) -> bool:
+        for k, v in pod.spec.node_selector.items():
+            if node.metadata.labels.get(k) != v:
+                return False
+        return self._tolerates(pod, node)
+
+    def _tolerates(self, pod: Pod, node: Node) -> bool:
+        """NoSchedule taint semantics (GKE TPU pools carry a google.com/tpu
+        taint so non-TPU pods never land on TPU hosts)."""
+        for taint in node.spec.get("taints", []):
+            if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+                continue
+            key = taint.get("key", "")
+            if key == TPU_RESOURCE and pod_tpu_request(pod) > 0:
+                continue  # device-plugin auto-toleration
+            if not any(
+                t.key == key or (not t.key and t.operator == "Exists")
+                for t in pod.spec.tolerations
+            ):
+                return False
+        return True
+
+    def _gang_size(self, pod: Pod) -> int:
+        """Replicas of the owning StatefulSet (1 for standalone pods)."""
+        ref = controller_owner(pod)
+        if ref is None or ref.kind != "StatefulSet":
+            return 1
+        try:
+            sts = self.client.get(StatefulSet, pod.metadata.namespace, ref.name)
+        except NotFoundError:
+            return 1
+        return sts.spec.replicas if sts.spec.replicas is not None else 1
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        try:
+            pod = self.client.get(Pod, req.namespace, req.name)
+        except NotFoundError:
+            return None
+        if pod.spec.node_name or pod.metadata.deletion_timestamp:
+            return None
+
+        nodes = self.client.list(Node)
+        candidates = [n for n in nodes if self._selector_matches(pod, n)]
+        tpu_chips = pod_tpu_request(pod)
+        assignment = self._assignment_map()
+        chosen: Optional[Node] = None
+
+        if tpu_chips > 0:
+            # group candidate nodes by pool; a pool == one ICI slice
+            pools: Dict[str, List[Node]] = {}
+            for n in candidates:
+                pools.setdefault(
+                    n.metadata.labels.get(GKE_NODEPOOL_LABEL, n.metadata.name), []
+                ).append(n)
+            gang = self._gang_size(pod)
+            sibling_pool = self._sibling_pool(pod)
+            for pool_name in sorted(pools):
+                # siblings already placed in a pool pin the gang there
+                if sibling_pool is not None and sibling_pool != pool_name:
+                    continue
+                pool_nodes = pools[pool_name]
+                free = [
+                    n for n in pool_nodes if self._node_free(n, pod, tpu_chips, assignment)
+                ]
+                if sibling_pool is None and gang > 1 and len(free) < gang:
+                    continue  # all-or-nothing: a fresh gang needs the whole slice
+                if free:
+                    ordinal = pod.metadata.labels.get("apps.kubernetes.io/pod-index")
+                    free.sort(key=lambda n: n.metadata.name)
+                    idx = int(ordinal) % len(free) if ordinal is not None else 0
+                    chosen = free[min(idx, len(free) - 1)]
+                    break
+        else:
+            free = [n for n in candidates if self._node_free(n, pod, 0, assignment)]
+            chosen = min(
+                free,
+                key=lambda n: len(assignment.get(n.metadata.name, [])),
+                default=None,
+            )
+
+        if chosen is None:
+            self._emit_unschedulable(pod, tpu_chips)
+            return Result(requeue_after=0.5)
+
+        pod.spec.node_name = chosen.metadata.name
+        self.client.update(pod)
+        return None
+
+    def _sibling_pool(self, pod: Pod) -> Optional[str]:
+        ref = controller_owner(pod)
+        if ref is None or ref.kind != "StatefulSet":
+            return None
+        for p in self.client.list(Pod, namespace=pod.metadata.namespace):
+            if p.metadata.name == pod.metadata.name or not p.spec.node_name:
+                continue
+            pref = controller_owner(p)
+            if pref and pref.uid == ref.uid:
+                try:
+                    node = self.client.get(Node, "", p.spec.node_name)
+                except NotFoundError:
+                    continue
+                return node.metadata.labels.get(GKE_NODEPOOL_LABEL)
+        return None
+
+    def _emit_unschedulable(self, pod: Pod, tpu_chips: int) -> None:
+        ev = Event()
+        ev.metadata.name = f"{pod.metadata.name}.sched{next(_event_seq)}"
+        ev.metadata.namespace = pod.metadata.namespace
+        ev.involved_object = ObjectReference(
+            api_version="v1",
+            kind="Pod",
+            name=pod.metadata.name,
+            namespace=pod.metadata.namespace,
+            uid=pod.metadata.uid,
+        )
+        ev.reason = "FailedScheduling"
+        ev.type = "Warning"
+        ev.message = (
+            f"0/{len(self.client.list(Node))} nodes available for "
+            f"{tpu_chips} {TPU_RESOURCE} chips (gang all-or-nothing)"
+            if tpu_chips
+            else "no node with sufficient cpu/memory"
+        )
+        ev.last_timestamp = now_rfc3339()
+        ev.count = 1
+        try:
+            self.client.create(ev)
+        except Exception:
+            pass

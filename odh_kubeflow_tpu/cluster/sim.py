@@ -1,0 +1,141 @@
+"""SimCluster: the one-process cluster fixture.
+
+Boots the store plus the system controllers (scheduler, statefulset, kubelet)
+on their own manager — the analog of envtest + KinD in the reference's test
+pyramid (SURVEY §4), extended with TPU node pools and real per-pod HTTP
+servers. Product controllers run on a SEPARATE manager, exactly like the
+reference's two-process split against one API server."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..api.core import Node
+from ..apimachinery import AlreadyExistsError
+from ..runtime.manager import Manager
+from ..tpu import (
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    plan_slice,
+)
+from .client import Client
+from .kubelet import Behavior, Kubelet, PodDecision
+from .scheduler import Scheduler
+from .statefulset import StatefulSetController
+from .store import Store
+
+
+class SimCluster:
+    def __init__(self) -> None:
+        self.store = Store()
+        self.client = Client(self.store)
+        self.system = Manager(self.store)
+        self.scheduler = Scheduler(self.system)
+        self.sts_controller = StatefulSetController(self.system)
+        self.kubelet = Kubelet(self.system)
+        self.scheduler.setup()
+        self.sts_controller.setup()
+        self.kubelet.setup()
+        self._started = False
+
+    # -- lifecycle --
+    def start(self) -> "SimCluster":
+        self.system.start()
+        self._started = True
+        return self
+
+    def stop(self) -> None:
+        self.system.stop()
+        self.kubelet.shutdown_servers()
+        self._started = False
+
+    def __enter__(self) -> "SimCluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def wait_idle(self, timeout: float = 30.0) -> bool:
+        return self.system.wait_idle(timeout=timeout)
+
+    # -- node pools --
+    def add_tpu_pool(
+        self, name: str, accelerator: str, topology: str, slices: int = 1
+    ) -> List[Node]:
+        """One GKE-style TPU node pool per ICI slice: `slices` slices of
+        `accelerator`/`topology`, each slice = its own pool `{name}-{i}`."""
+        shape = plan_slice(accelerator, topology=topology)
+        nodes = []
+        for s in range(slices):
+            pool = f"{name}-{s}" if slices > 1 else name
+            for h in range(shape.hosts):
+                node = Node()
+                node.metadata.name = f"{pool}-w{h}"
+                node.metadata.labels = {
+                    GKE_NODEPOOL_LABEL: pool,
+                    GKE_TPU_ACCELERATOR_LABEL: shape.gke_accelerator,
+                    GKE_TPU_TOPOLOGY_LABEL: shape.topology,
+                }
+                node.spec = {
+                    "taints": [
+                        {"key": TPU_RESOURCE, "value": "present", "effect": "NoSchedule"}
+                    ]
+                }
+                node.status.allocatable = {
+                    "cpu": "96",
+                    "memory": str(400 * 2**30),
+                    TPU_RESOURCE: str(shape.chips_per_host),
+                }
+                node.status.capacity = dict(node.status.allocatable)
+                try:
+                    nodes.append(self.client.create(node))
+                except AlreadyExistsError:
+                    pass
+        return nodes
+
+    def add_cpu_pool(self, name: str, nodes: int = 1, cpu: str = "16", memory_gi: int = 64) -> List[Node]:
+        out = []
+        for i in range(nodes):
+            node = Node()
+            node.metadata.name = f"{name}-{i}"
+            node.metadata.labels = {GKE_NODEPOOL_LABEL: name}
+            node.status.allocatable = {"cpu": cpu, "memory": str(memory_gi * 2**30)}
+            node.status.capacity = dict(node.status.allocatable)
+            try:
+                out.append(self.client.create(node))
+            except AlreadyExistsError:
+                pass
+        return out
+
+    # -- pod behaviors (startup latency, failures, real servers) --
+    def add_pod_behavior(self, behavior: Behavior) -> None:
+        self.kubelet.add_behavior(behavior)
+
+    # -- cluster DNS --
+    def resolve(self, host: str) -> Optional[Tuple[str, int]]:
+        """Resolve '{pod}.{svc}.{ns}.svc...' / '{svc}.{ns}.svc...' to a real
+        (host, port) if the pod runs a registered server."""
+        parts = host.split(".")
+        if len(parts) >= 4 and parts[2] == "svc":
+            #  {svc}.{ns}.svc... -> ordinal-0 pod of the same-named notebook
+            svc, ns = parts[0], parts[1]
+            return self.kubelet.server_for(ns, f"{svc}-0")
+        if len(parts) >= 5 and parts[3] == "svc":
+            pod, _svc, ns = parts[0], parts[1], parts[2]
+            return self.kubelet.server_for(ns, pod)
+        return None
+
+    def http_get(self, url: str, timeout: float = 10.0) -> Tuple[int, bytes]:
+        """Cluster-DNS-aware HTTP GET (the culler's probe transport)."""
+        import urllib.request
+
+        u = urlparse(url)
+        target = self.resolve(u.hostname or "")
+        if target is None:
+            raise ConnectionError(f"no endpoints for {u.hostname}")
+        host, port = target
+        rewritten = u._replace(netloc=f"{host}:{port}").geturl()
+        with urllib.request.urlopen(rewritten, timeout=timeout) as resp:
+            return resp.status, resp.read()

@@ -1,0 +1,14 @@
+from .env import COORDINATOR_PORT, ordinal_env, pod_dns, tpu_env
+from .topology import (
+    GENERATIONS,
+    GKE_NODEPOOL_LABEL,
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    SliceShape,
+    TPUGeneration,
+    chips_per_host_bounds,
+    host_bounds,
+    parse_topology,
+    plan_slice,
+)

@@ -1,0 +1,79 @@
+"""TPU runtime environment injection.
+
+The reference webhook injects CUDA-toolkit/GPU env; here the webhook injects
+the JAX/PJRT/libtpu contract instead (BASELINE.json north star): platform
+selection, per-ordinal worker identity, the slice's host roster, and the
+`jax.distributed` coordinator derived from the headless Service's stable DNS
+(host 0). For multi-host slices these env vars are exactly what
+`jax.distributed.initialize()` and libtpu need to wire the ICI mesh.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .topology import SliceShape, chips_per_host_bounds, host_bounds
+
+COORDINATOR_PORT = 8476  # jax.distributed default coordinator port
+
+
+def pod_dns(name: str, ordinal: int, service: str, namespace: str, domain: str) -> str:
+    return f"{name}-{ordinal}.{service}.{namespace}.svc.{domain}"
+
+
+def tpu_env(
+    shape: SliceShape,
+    notebook_name: str,
+    service_name: str,
+    namespace: str,
+    cluster_domain: str = "cluster.local",
+    runtime: str = "jax",
+) -> List[Dict[str, str]]:
+    """Env var list (name/value dicts, ordinal templated) for the primary
+    container. TPU_WORKER_ID derives from the pod ordinal via the downward
+    API (statefulset pod-index label) — see webhook injection."""
+    hostnames = ",".join(
+        pod_dns(notebook_name, i, service_name, namespace, cluster_domain)
+        for i in range(shape.hosts)
+    )
+    coordinator = (
+        pod_dns(notebook_name, 0, service_name, namespace, cluster_domain)
+        + f":{COORDINATOR_PORT}"
+    )
+    env = [
+        {"name": "TPU_ACCELERATOR_TYPE", "value": shape.accelerator_type},
+        {"name": "TPU_TOPOLOGY", "value": shape.topology},
+        {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
+        {"name": "TPU_CHIPS_PER_HOST_BOUNDS", "value": chips_per_host_bounds(shape)},
+        {"name": "TPU_HOST_BOUNDS", "value": host_bounds(shape)},
+        {"name": "TPU_RUNTIME_METRICS_PORTS", "value": "8431"},
+        {"name": "NB_TPU_HOSTS", "value": str(shape.hosts)},
+        {"name": "NB_TPU_CHIPS_EXPECTED", "value": str(shape.chips)},
+    ]
+    if runtime == "pytorch-xla":
+        env += [
+            {"name": "PJRT_DEVICE", "value": "TPU"},
+            {"name": "XLA_USE_SPMD", "value": "1"},
+        ]
+    else:
+        env += [{"name": "JAX_PLATFORMS", "value": "tpu"}]
+    if shape.multi_host:
+        env += [
+            {"name": "JAX_COORDINATOR_ADDRESS", "value": coordinator},
+            {"name": "JAX_NUM_PROCESSES", "value": str(shape.hosts)},
+            # TPU_WORKER_ID / JAX_PROCESS_ID come from the pod ordinal,
+            # injected per-pod via the downward-API (pod-index label)
+        ]
+    return env
+
+
+def ordinal_env() -> List[Dict[str, object]]:
+    """Downward-API env: the StatefulSet pod index becomes the TPU worker id
+    (the per-ordinal piece the reference's single-pod design never needed —
+    SURVEY §5 long-context analog: every {name}-0 site generalized)."""
+    field_ref = {
+        "fieldRef": {"fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}
+    }
+    return [
+        {"name": "TPU_WORKER_ID", "valueFrom": field_ref},
+        {"name": "JAX_PROCESS_ID", "valueFrom": field_ref},
+    ]

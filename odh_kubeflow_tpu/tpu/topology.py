@@ -1,0 +1,173 @@
+"""TPU generations, topologies, and the slice planner.
+
+This is the TPU-native replacement for the reference's GPU path: where the
+reference schedules a notebook onto "a node with nvidia.com/gpu", this module
+turns ``Notebook.spec.tpu`` (accelerator + topology or chip count) into the
+concrete slice shape — host count, chips per host, GKE node selectors
+(`cloud.google.com/gke-tpu-accelerator`, `cloud.google.com/gke-tpu-topology`)
+and the `google.com/tpu` resource request — per the BASELINE.json north star.
+
+Topology model (public TPU system architecture):
+- a *slice* is a set of hosts wired by ICI; each host carries a fixed number
+  of chips (4 for v4/v5p boards; v5e/v6e also offer 1- and 8-chip single-host
+  machine shapes),
+- v4/v5p topologies are 3D meshes "XxYxZ" of chips; v5e/v6e are 2D "XxY",
+- workloads occupy whole hosts: `google.com/tpu` is requested per pod at
+  chips-per-host granularity, one pod per host, `replicas = hosts`.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..apimachinery import InvalidError
+
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
+TPU_RESOURCE = "google.com/tpu"
+
+
+@dataclass(frozen=True)
+class TPUGeneration:
+    name: str  # "v4" | "v5e" | "v5p" | "v6e"
+    gke_accelerator: str  # value of the gke-tpu-accelerator node label
+    dims: int  # topology rank: 3 for v4/v5p, 2 for v5e/v6e
+    chips_per_host: int  # chips on one multi-host board
+    max_single_host_chips: int  # largest single-host machine shape
+    cores_per_chip: int  # for the "v5p-32"-style core-count alias
+    max_chips: int  # largest supported slice
+
+
+GENERATIONS: Dict[str, TPUGeneration] = {
+    "v4": TPUGeneration("v4", "tpu-v4-podslice", 3, 4, 4, 2, 4096),
+    "v5e": TPUGeneration("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 1, 256),
+    "v5p": TPUGeneration("v5p", "tpu-v5p-slice", 3, 4, 4, 2, 8960),
+    "v6e": TPUGeneration("v6e", "tpu-v6e-slice", 2, 4, 8, 1, 256),
+}
+
+
+def parse_topology(topology: str, dims: int) -> Tuple[int, ...]:
+    try:
+        parts = tuple(int(p) for p in topology.lower().split("x"))
+    except ValueError:
+        raise InvalidError(f"malformed TPU topology {topology!r}")
+    if len(parts) != dims or any(p < 1 for p in parts):
+        raise InvalidError(
+            f"TPU topology {topology!r} must be {dims} positive dims (e.g. "
+            + ("'2x2x2'" if dims == 3 else "'2x4'")
+        )
+    return parts
+
+
+@dataclass(frozen=True)
+class SliceShape:
+    """Fully-resolved slice placement plan."""
+
+    accelerator: str  # generation name, e.g. "v5p"
+    topology: str  # canonical "XxY[xZ]"
+    chips: int  # total chips in the slice
+    hosts: int  # pod/host count (StatefulSet replicas)
+    chips_per_host: int  # google.com/tpu request per pod
+    gke_accelerator: str  # node label value
+    multi_host: bool = False
+
+    @property
+    def accelerator_type(self) -> str:
+        """Core-count alias, e.g. v5p 2x2x4 -> 'v5p-32' (16 chips x 2 cores)."""
+        gen = GENERATIONS[self.accelerator]
+        return f"{self.accelerator}-{self.chips * gen.cores_per_chip}"
+
+    def node_selector(self) -> Dict[str, str]:
+        return {
+            GKE_TPU_ACCELERATOR_LABEL: self.gke_accelerator,
+            GKE_TPU_TOPOLOGY_LABEL: self.topology,
+        }
+
+
+def _standard_topologies(gen: TPUGeneration) -> List[Tuple[int, ...]]:
+    """Enumerate doubling topologies (1x1[x1] ... up to max_chips), the shapes
+    GKE node pools actually come in."""
+    shapes: List[Tuple[int, ...]] = []
+    dims = [1] * gen.dims
+    shapes.append(tuple(dims))
+    while math.prod(dims) < gen.max_chips:
+        # double the smallest dimension (keeps shapes near-cubic/square)
+        j = min(range(gen.dims), key=lambda k: dims[k])
+        dims[j] *= 2
+        shapes.append(tuple(sorted(dims)))
+    return shapes
+
+
+def plan_slice(
+    accelerator: str, topology: str = "", chips: int = 0
+) -> SliceShape:
+    """Resolve a ``spec.tpu`` block into a SliceShape.
+
+    Exactly one of topology/chips may drive sizing; with neither, the minimum
+    slice (one host, all its chips) is planned.
+    """
+    gen = GENERATIONS.get(accelerator)
+    if gen is None:
+        raise InvalidError(
+            f"unknown TPU accelerator {accelerator!r}; valid: {sorted(GENERATIONS)}"
+        )
+    if topology and chips:
+        raise InvalidError("spec.tpu: set topology or chips, not both")
+
+    if topology:
+        shape = parse_topology(topology, gen.dims)
+        total = math.prod(shape)
+    elif chips:
+        for cand in _standard_topologies(gen):
+            if math.prod(cand) >= chips:
+                shape, total = cand, math.prod(cand)
+                break
+        else:
+            raise InvalidError(
+                f"no {gen.name} topology with >= {chips} chips (max {gen.max_chips})"
+            )
+    else:
+        total = gen.chips_per_host
+        shape = parse_topology(
+            {2: f"2x2", 3: f"2x2x1"}[gen.dims], gen.dims
+        )
+
+    if total > gen.max_chips:
+        raise InvalidError(f"{gen.name} slice of {total} chips exceeds max {gen.max_chips}")
+
+    if total <= gen.max_single_host_chips:
+        hosts, per_host = 1, total
+    else:
+        if total % gen.chips_per_host != 0:
+            raise InvalidError(
+                f"{gen.name} multi-host slice must be a multiple of "
+                f"{gen.chips_per_host} chips, got {total}"
+            )
+        hosts, per_host = total // gen.chips_per_host, gen.chips_per_host
+
+    return SliceShape(
+        accelerator=gen.name,
+        topology="x".join(str(d) for d in shape),
+        chips=total,
+        hosts=hosts,
+        chips_per_host=per_host,
+        gke_accelerator=gen.gke_accelerator,
+        multi_host=hosts > 1,
+    )
+
+
+def chips_per_host_bounds(shape: SliceShape) -> str:
+    """TPU_CHIPS_PER_HOST_BOUNDS-style chip layout on one host ("2,2,1")."""
+    gen = GENERATIONS[shape.accelerator]
+    if gen.dims == 3:
+        return {4: "2,2,1", 1: "1,1,1"}.get(shape.chips_per_host, "2,2,1")
+    return {8: "2,4", 4: "2,2", 1: "1,1"}.get(shape.chips_per_host, "2,2")
+
+
+def host_bounds(shape: SliceShape) -> str:
+    """TPU_HOST_BOUNDS-style host grid within the slice."""
+    dims = parse_topology(shape.topology, GENERATIONS[shape.accelerator].dims)
+    per_host = [int(p) for p in chips_per_host_bounds(shape).split(",")]
+    return ",".join(str(max(1, d // p)) for d, p in zip(dims, per_host))

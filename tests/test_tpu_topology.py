@@ -1,0 +1,93 @@
+"""Slice planner: topology parsing, host math, GKE selectors, env contract."""
+import pytest
+
+from odh_kubeflow_tpu.apimachinery import InvalidError
+from odh_kubeflow_tpu.tpu import (
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    host_bounds,
+    plan_slice,
+    tpu_env,
+)
+
+
+def test_v5p_32_shape():
+    # BASELINE target config: multi-host v5p-32 (16 chips, 4 hosts x 4 chips)
+    s = plan_slice("v5p", topology="2x2x4")
+    assert s.chips == 16
+    assert s.hosts == 4
+    assert s.chips_per_host == 4
+    assert s.multi_host
+    assert s.accelerator_type == "v5p-32"
+    assert s.node_selector() == {
+        GKE_TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+        GKE_TPU_TOPOLOGY_LABEL: "2x2x4",
+    }
+
+
+def test_v5e_4_single_host():
+    # BASELINE target config: single-host v5e-4
+    s = plan_slice("v5e", topology="2x2")
+    assert s.chips == 4 and s.hosts == 1 and not s.multi_host
+    assert s.chips_per_host == 4
+
+
+def test_v5e_8_single_host_machine():
+    s = plan_slice("v5e", topology="2x4")
+    assert s.chips == 8 and s.hosts == 1  # ct5lp-hightpu-8t shape
+
+
+def test_v5e_16_multi_host():
+    # BASELINE target config: PyTorch/XLA on v5e-16
+    s = plan_slice("v5e", topology="4x4")
+    assert s.chips == 16 and s.hosts == 4 and s.chips_per_host == 4
+
+
+def test_chips_requests_smallest_topology():
+    s = plan_slice("v5p", chips=10)
+    assert s.chips >= 10
+    assert s.hosts == s.chips // 4
+
+
+def test_default_is_one_host():
+    s = plan_slice("v5e")
+    assert s.hosts == 1 and s.chips == 4
+
+
+def test_invalid_inputs():
+    with pytest.raises(InvalidError):
+        plan_slice("v7x")
+    with pytest.raises(InvalidError):
+        plan_slice("v5p", topology="2x2")  # v5p is 3D
+    with pytest.raises(InvalidError):
+        plan_slice("v5e", topology="2x2x2")  # v5e is 2D
+    with pytest.raises(InvalidError):
+        plan_slice("v5p", topology="banana")
+    with pytest.raises(InvalidError):
+        plan_slice("v5p", topology="2x2x2", chips=8)
+    with pytest.raises(InvalidError):
+        plan_slice("v5e", chips=100000)
+
+
+def test_host_bounds_partition_topology():
+    s = plan_slice("v5p", topology="2x2x4")
+    assert host_bounds(s) == "1,1,4"  # 4 hosts of 2x2x1 chips stacked in z
+
+
+def test_env_contract_multi_host():
+    s = plan_slice("v5p", topology="2x2x4")
+    env = {e["name"]: e["value"] for e in tpu_env(s, "nb", "nb", "user")}
+    assert env["JAX_PLATFORMS"] == "tpu"
+    assert env["JAX_NUM_PROCESSES"] == "4"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "nb-0.nb.user.svc.cluster.local:8476"
+    hostnames = env["TPU_WORKER_HOSTNAMES"].split(",")
+    assert len(hostnames) == 4
+    assert hostnames[3] == "nb-3.nb.user.svc.cluster.local"
+    assert env["NB_TPU_CHIPS_EXPECTED"] == "16"
+
+
+def test_env_contract_pytorch():
+    s = plan_slice("v5e", topology="4x4")
+    env = {e["name"]: e["value"] for e in tpu_env(s, "nb", "nb", "u", runtime="pytorch-xla")}
+    assert env["PJRT_DEVICE"] == "TPU"
+    assert "JAX_PLATFORMS" not in env
