@@ -8,6 +8,7 @@ is an apiVersion rewrite with a lossless round-trip through the JSON form.
 from __future__ import annotations
 
 from ...apimachinery import default_scheme
+from ...cluster.store import register_storage_alias
 from .v1beta1 import API_VERSION as HUB_API_VERSION
 from .v1beta1 import KIND, Notebook
 
@@ -15,6 +16,10 @@ SERVED_VERSIONS = ("kubeflow.org/v1beta1", "kubeflow.org/v1", "kubeflow.org/v1al
 
 for _v in SERVED_VERSIONS[1:]:
     default_scheme.register(_v, KIND, Notebook)
+    # spoke writes land in the hub bucket so hub watches/reads see them
+    # (the conversion-webhook analog; reference serves all three versions
+    # through one storage version)
+    register_storage_alias(_v, KIND, HUB_API_VERSION)
 
 
 def convert_to_hub(nb: Notebook) -> Notebook:

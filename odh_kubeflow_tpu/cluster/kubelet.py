@@ -110,6 +110,15 @@ class Kubelet:
         key = req.key
 
         if decision.fail:
+            already_failed = (
+                pod.status.container_statuses
+                and pod.status.container_statuses[0].state
+                and pod.status.container_statuses[0].state.waiting
+                and pod.status.container_statuses[0].state.waiting.get("reason")
+                == decision.fail
+            )
+            if already_failed:
+                return None  # steady state: don't churn status/watch events
             pod.status.phase = "Pending"
             pod.status.container_statuses = [
                 ContainerStatus(

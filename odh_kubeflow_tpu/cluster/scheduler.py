@@ -13,19 +13,20 @@ every multi-host pool IS one ICI slice. Placement rules:
 """
 from __future__ import annotations
 
-import itertools
 from typing import Dict, List, Optional
 
 from ..api.apps import StatefulSet
 from ..api.core import Event, Node, ObjectReference, Pod
-from ..apimachinery import NotFoundError, controller_owner, now_rfc3339
+from ..apimachinery import (
+    AlreadyExistsError,
+    NotFoundError,
+    controller_owner,
+    now_rfc3339,
+)
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import GKE_NODEPOOL_LABEL, TPU_RESOURCE
 from ..utils import parse_quantity
-
-_event_seq = itertools.count(1)
-
 
 def pod_tpu_request(pod: Pod) -> int:
     total = 0
@@ -193,8 +194,28 @@ class Scheduler:
         return None
 
     def _emit_unschedulable(self, pod: Pod, tpu_chips: int) -> None:
+        """One Event per pod+reason, deduplicated Kubernetes-style: repeats
+        bump count/lastTimestamp instead of growing the store."""
+        name = f"{pod.metadata.name}.unschedulable"
+        message = (
+            f"0/{len(self.client.list(Node))} nodes available for "
+            f"{tpu_chips} {TPU_RESOURCE} chips (gang all-or-nothing)"
+            if tpu_chips
+            else "no node with sufficient cpu/memory"
+        )
+        try:
+            existing = self.client.get(Event, pod.metadata.namespace, name)
+            self.client.patch(
+                Event,
+                pod.metadata.namespace,
+                name,
+                {"count": existing.count + 1, "lastTimestamp": now_rfc3339(), "message": message},
+            )
+            return
+        except NotFoundError:
+            pass
         ev = Event()
-        ev.metadata.name = f"{pod.metadata.name}.sched{next(_event_seq)}"
+        ev.metadata.name = name
         ev.metadata.namespace = pod.metadata.namespace
         ev.involved_object = ObjectReference(
             api_version="v1",
@@ -203,17 +224,14 @@ class Scheduler:
             namespace=pod.metadata.namespace,
             uid=pod.metadata.uid,
         )
+        ev.set_owner(pod)  # GC'd with the pod
         ev.reason = "FailedScheduling"
         ev.type = "Warning"
-        ev.message = (
-            f"0/{len(self.client.list(Node))} nodes available for "
-            f"{tpu_chips} {TPU_RESOURCE} chips (gang all-or-nothing)"
-            if tpu_chips
-            else "no node with sufficient cpu/memory"
-        )
+        ev.message = message
+        ev.first_timestamp = now_rfc3339()
         ev.last_timestamp = now_rfc3339()
         ev.count = 1
         try:
             self.client.create(ev)
-        except Exception:
+        except AlreadyExistsError:
             pass

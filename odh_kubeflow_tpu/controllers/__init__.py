@@ -1,0 +1,4 @@
+from . import constants
+from .config import Config
+from .metrics import NotebookMetrics
+from .notebook import EventMirrorController, NotebookReconciler, hosts_service_name

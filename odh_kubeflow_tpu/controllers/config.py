@@ -1,0 +1,67 @@
+"""Controller configuration.
+
+The reference stacks CLI flags + env vars + kustomize params (SURVEY §5
+config/flag system); this build centralizes them in one dataclass whose
+from_env() reads the same env names the reference uses, so deployment
+manifests translate directly."""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Config:
+    # core reconciler (reference notebook_controller.go:238,514,576-599)
+    cluster_domain: str = "cluster.local"
+    add_fsgroup: bool = True
+
+    # culling (reference culling_controller.go:525-558; minutes, same defaults)
+    enable_culling: bool = False
+    cull_idle_time_min: float = 1440.0
+    idleness_check_period_min: float = 1.0
+    dev_mode: bool = False
+
+    # TPU-native culling signal: require BOTH Jupyter-idle and TPU-idle
+    tpu_idle_threshold: float = 0.05  # duty cycle below which the slice is idle
+    probe_port: int = 8889
+
+    # extension controller / webhook (reference odh main.go + webhook consts)
+    auth_proxy_image: str = "kube-rbac-proxy:latest"
+    gateway_name: str = "data-science-gateway"
+    gateway_namespace: str = "openshift-ingress"
+    controller_namespace: str = "tpu-notebooks-system"
+    set_pipeline_rbac: bool = False
+    set_pipeline_secret: bool = False
+    inject_cluster_proxy_env: bool = False
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        c = cls()
+        c.cluster_domain = os.environ.get("CLUSTER_DOMAIN", c.cluster_domain)
+        c.add_fsgroup = _env_bool("ADD_FSGROUP", c.add_fsgroup)
+        c.enable_culling = _env_bool("ENABLE_CULLING", c.enable_culling)
+        if os.environ.get("CULL_IDLE_TIME"):
+            c.cull_idle_time_min = float(os.environ["CULL_IDLE_TIME"])
+        if os.environ.get("IDLENESS_CHECK_PERIOD"):
+            c.idleness_check_period_min = float(os.environ["IDLENESS_CHECK_PERIOD"])
+        c.dev_mode = _env_bool("DEV", c.dev_mode)
+        c.gateway_name = os.environ.get("NOTEBOOK_GATEWAY_NAME", c.gateway_name)
+        c.gateway_namespace = os.environ.get(
+            "NOTEBOOK_GATEWAY_NAMESPACE", c.gateway_namespace
+        )
+        c.controller_namespace = os.environ.get("K8S_NAMESPACE", c.controller_namespace)
+        c.set_pipeline_rbac = _env_bool("SET_PIPELINE_RBAC", c.set_pipeline_rbac)
+        c.set_pipeline_secret = _env_bool("SET_PIPELINE_SECRET", c.set_pipeline_secret)
+        c.inject_cluster_proxy_env = _env_bool(
+            "INJECT_CLUSTER_PROXY_ENV", c.inject_cluster_proxy_env
+        )
+        return c

@@ -1,0 +1,48 @@
+"""Annotation/label contracts of the notebook stack.
+
+Keys are kept byte-identical to the reference where they are user-facing
+contracts (stop/culling state machine, restart, update-pending, auth) so CRs
+and tooling written for the reference keep working (reference
+pkg/culler/culler.go:40-41, odh notebook_controller.go:56-79,
+notebook_webhook.go constants)."""
+
+# -- stop / culling state machine --
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+RECONCILIATION_LOCK_VALUE = "odh-notebook-controller-lock"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
+    "notebooks.kubeflow.org/last_activity_check_timestamp"
+)
+
+# -- core reconciler --
+NOTEBOOK_NAME_LABEL = "notebook-name"
+NOTEBOOK_RESTART_ANNOTATION = "notebooks.opendatahub.io/notebook-restart"
+NOTEBOOK_PORT = 8888
+NOTEBOOK_PORT_NAME = "http-notebook"  # service port name (Istio/mesh RBAC relies on it)
+DEFAULT_WORKING_DIR = "/home/jovyan"
+DEFAULT_FS_GROUP = 100
+PREFIX_ENV = "NB_PREFIX"
+
+# -- webhook / extension --
+UPDATE_PENDING_ANNOTATION = "notebooks.opendatahub.io/update-pending"
+INJECT_AUTH_ANNOTATION = "notebooks.opendatahub.io/inject-auth"
+IMAGE_SELECTION_ANNOTATION = "notebooks.opendatahub.io/last-image-selection"
+IMAGE_NAMESPACE_ANNOTATION = "notebooks.opendatahub.io/workbench-image-namespace"
+AUTH_SIDECAR_CPU_REQUEST_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-cpu-request"
+AUTH_SIDECAR_MEMORY_REQUEST_ANNOTATION = (
+    "notebooks.opendatahub.io/auth-sidecar-memory-request"
+)
+AUTH_SIDECAR_CPU_LIMIT_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-cpu-limit"
+AUTH_SIDECAR_MEMORY_LIMIT_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-memory-limit"
+FEAST_LABEL = "opendatahub.io/feast-integration"
+RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
+
+# -- TPU-native additions --
+TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"
+TPU_PROBE_PORT = 8889  # in-pod probe agent (readiness + utilization + activity)
+TPU_IDLE_ANNOTATION = "notebooks.tpu.kubeflow.org/tpu-last-busy"
+
+# -- finalizers (extension controller) --
+ROUTE_FINALIZER = "notebooks.tpu.kubeflow.org/route-cleanup"
+REFERENCE_GRANT_FINALIZER = "notebooks.tpu.kubeflow.org/referencegrant-cleanup"
+AUTH_BINDING_FINALIZER = "notebooks.tpu.kubeflow.org/auth-binding-cleanup"

@@ -1,0 +1,69 @@
+"""Notebook controller metrics.
+
+Same five series as the reference collector (reference
+pkg/metrics/metrics.go:22-99) plus the TPU-native ones the north star demands:
+chips bound and the Notebook-CR->slice-ready latency histogram (the self-
+measured headline metric)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api.apps import StatefulSet
+from ..api.notebook import Notebook
+from ..cluster.client import Client
+from ..runtime.metrics import Registry
+from ..tpu import TPU_RESOURCE
+from . import constants as C
+
+
+class NotebookMetrics:
+    def __init__(self, registry: Registry, client: Optional[Client] = None):
+        self.registry = registry
+        self.client = client
+        self.notebook_create_total = registry.counter(
+            "notebook_create_total", "Total times of creating notebook"
+        )
+        self.notebook_create_failed_total = registry.counter(
+            "notebook_create_failed_total", "Total failure times of creating notebook"
+        )
+        self.notebook_culling_total = registry.counter(
+            "notebook_culling_total", "Total times of culling notebook"
+        )
+        self.last_culling_timestamp = registry.gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Timestamp of the last notebook culling in seconds",
+        )
+        self.notebook_running = registry.gauge(
+            "notebook_running_total", "Current running notebooks in the cluster"
+        )
+        # TPU-native series
+        self.tpu_chips_bound = registry.gauge(
+            "notebook_tpu_chips_bound", "TPU chips currently bound to notebooks"
+        )
+        self.slice_ready_seconds = registry.histogram(
+            "notebook_slice_ready_seconds",
+            "Notebook CR to slice-ready latency (the north-star metric)",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300),
+        )
+        if client is not None:
+            registry.add_collector(self._scrape)
+
+    def _scrape(self) -> None:
+        """Pull-style collector: list StatefulSets at scrape time (reference
+        Metrics.scrape :82-99) and aggregate running notebooks + bound chips."""
+        assert self.client is not None
+        running = 0
+        chips = 0
+        for sts in self.client.list(StatefulSet):
+            if C.NOTEBOOK_NAME_LABEL not in sts.spec.template.metadata.labels:
+                continue
+            if sts.metadata.labels.get(C.NOTEBOOK_NAME_LABEL) != sts.metadata.name:
+                continue
+            ready = sts.status.ready_replicas
+            if ready > 0:
+                running += 1
+            for c in sts.spec.template.spec.containers:
+                if c.resources and c.resources.requests.get(TPU_RESOURCE):
+                    chips += ready * int(float(c.resources.requests[TPU_RESOURCE]))
+        self.notebook_running.set(running)
+        self.tpu_chips_bound.set(chips)

@@ -81,16 +81,20 @@ class Informer:
         if self._watch is not None:
             self._watch.stop()
 
-    # -- cache reads --
+    # -- cache reads (deep-copied: callers must never mutate the cache) --
     def get(self, namespace: str, name: str) -> Optional[dict]:
+        import copy
+
         key = f"{namespace}/{name}" if namespace else name
         with self._lock:
             obj = self._cache.get(key)
-            return dict(obj) if obj else None
+            return copy.deepcopy(obj) if obj else None
 
     def list(self) -> List[dict]:
+        import copy
+
         with self._lock:
-            return list(self._cache.values())
+            return [copy.deepcopy(o) for o in self._cache.values()]
 
 
 class InformerRegistry:

@@ -50,6 +50,7 @@ class LeaderElector:
         self.is_leader = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.on_stopped_leading: Optional[Callable[[], None]] = None
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._run, daemon=True, name="leader-elector")
@@ -57,6 +58,14 @@ class LeaderElector:
 
     def stop(self) -> None:
         self._stop.set()
+
+    @staticmethod
+    def _now() -> str:
+        # sub-second precision: whole-second truncation (now_rfc3339) would
+        # inflate lease age by up to 1s and let rivals steal a healthy lease
+        import datetime
+
+        return datetime.datetime.now(datetime.timezone.utc).isoformat()
 
     def _try_acquire(self) -> bool:
         try:
@@ -68,8 +77,8 @@ class LeaderElector:
             lease.spec = LeaseSpec(
                 holder_identity=self.identity,
                 lease_duration_seconds=int(self.lease_duration),
-                acquire_time=now_rfc3339(),
-                renew_time=now_rfc3339(),
+                acquire_time=self._now(),
+                renew_time=self._now(),
             )
             try:
                 self.client.create(lease)
@@ -77,15 +86,15 @@ class LeaderElector:
             except AlreadyExistsError:
                 return False
         if lease.spec.holder_identity == self.identity:
-            lease.spec.renew_time = now_rfc3339()
+            lease.spec.renew_time = self._now()
         else:
             if lease.spec.renew_time:
                 age = time.time() - parse_time(lease.spec.renew_time).timestamp()
                 if age < (lease.spec.lease_duration_seconds or self.lease_duration):
                     return False  # healthy other leader
             lease.spec.holder_identity = self.identity
-            lease.spec.acquire_time = now_rfc3339()
-            lease.spec.renew_time = now_rfc3339()
+            lease.spec.acquire_time = self._now()
+            lease.spec.renew_time = self._now()
             lease.spec.lease_transitions += 1
         try:
             self.client.update(lease)
@@ -95,10 +104,24 @@ class LeaderElector:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            if self._try_acquire():
+            acquired = self._try_acquire()
+            was_leader = self.is_leader.is_set()
+            if acquired:
                 self.is_leader.set()
             else:
                 self.is_leader.clear()
+                if was_leader:
+                    # leadership lost mid-flight: the manager must stand down
+                    # (controller-runtime terminates the process here)
+                    log.error(
+                        "leader election: lost lease %s/%s; standing down",
+                        self.namespace,
+                        self.lease_name,
+                    )
+                    cb = self.on_stopped_leading
+                    if cb is not None:
+                        cb()
+                    return
             self._stop.wait(self.renew_period)
 
 
@@ -138,6 +161,7 @@ class Manager:
         if self._started:
             return
         if self.elector is not None:
+            self.elector.on_stopped_leading = self.stop
             self.elector.start()
             if not self.elector.is_leader.wait(timeout=wait_for_leadership_timeout):
                 raise TimeoutError("failed to acquire leadership")

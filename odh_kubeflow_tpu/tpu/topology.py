@@ -92,7 +92,7 @@ def _standard_topologies(gen: TPUGeneration) -> List[Tuple[int, ...]]:
     shapes: List[Tuple[int, ...]] = []
     dims = [1] * gen.dims
     shapes.append(tuple(dims))
-    while math.prod(dims) < gen.max_chips:
+    while math.prod(dims) * 2 <= gen.max_chips:
         # double the smallest dimension (keeps shapes near-cubic/square)
         j = min(range(gen.dims), key=lambda k: dims[k])
         dims[j] *= 2

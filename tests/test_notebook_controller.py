@@ -1,0 +1,248 @@
+"""Core NotebookReconciler against the full SimCluster: the Milestone-A
+end-to-end slice (SURVEY §7 step 2) plus stop/restart/status semantics."""
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.apps import StatefulSet
+from odh_kubeflow_tpu.api.core import Container, Event, Pod, Service
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.apimachinery import NotFoundError
+from odh_kubeflow_tpu.cluster import SimCluster
+from odh_kubeflow_tpu.controllers import (
+    Config,
+    EventMirrorController,
+    NotebookReconciler,
+    constants as C,
+)
+from odh_kubeflow_tpu.runtime import Manager
+from odh_kubeflow_tpu.tpu import TPU_RESOURCE
+
+
+@pytest.fixture()
+def env():
+    """SimCluster + a separate product manager (mirrors the reference's
+    two-process layout against one API server)."""
+    cluster = SimCluster().start()
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, Config()).setup()
+    EventMirrorController(mgr).setup()
+    mgr.start()
+    yield cluster, mgr
+    mgr.stop()
+    cluster.stop()
+
+
+def mk_notebook(name, ns="user", tpu=None, image="jupyter:latest"):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    nb.spec.template.spec.containers = [Container(name=name, image=image)]
+    if tpu:
+        nb.spec.tpu = tpu
+    return nb
+
+
+def wait_for(fn, timeout=10, msg="condition"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+            last = out
+        except (NotFoundError, AssertionError) as e:
+            last = e
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}: last={last!r}")
+
+
+def test_cpu_notebook_end_to_end(env):
+    cluster, mgr = env
+    cluster.add_cpu_pool("cpu", nodes=1)
+    cluster.client.create(mk_notebook("mini"))
+
+    sts = wait_for(
+        lambda: cluster.client.get(StatefulSet, "user", "mini"), msg="statefulset"
+    )
+    assert sts.spec.replicas == 1
+    assert sts.spec.template.metadata.labels[C.NOTEBOOK_NAME_LABEL] == "mini"
+    tmpl_c = sts.spec.template.spec.containers[0]
+    assert tmpl_c.working_dir == C.DEFAULT_WORKING_DIR
+    assert tmpl_c.env_dict()[C.PREFIX_ENV] == "/notebook/user/mini"
+    assert tmpl_c.ports[0].container_port == C.NOTEBOOK_PORT
+    assert sts.spec.template.spec.security_context.fs_group == C.DEFAULT_FS_GROUP
+
+    svc = cluster.client.get(Service, "user", "mini")
+    assert svc.spec.ports[0].port == 80
+    assert svc.spec.ports[0].target_port == C.NOTEBOOK_PORT
+    assert svc.spec.ports[0].name == C.NOTEBOOK_PORT_NAME
+
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.ready_replicas == 1 else None
+        )(cluster.client.get(Notebook, "user", "mini")),
+        msg="notebook ready",
+    )
+    assert any(c.type == "Ready" and c.status == "True" for c in nb.status.conditions)
+    assert nb.status.container_state.running is not None
+
+
+def test_tpu_notebook_v5e4_milestone_a(env):
+    """Milestone A: one CR on a v5e-4 pool -> slice bound, chips visible."""
+    cluster, mgr = env
+    cluster.add_tpu_pool("v5e-pool", "v5e", "2x2")
+    cluster.client.create(mk_notebook("lab", tpu=TPUSpec(accelerator="v5e", topology="2x2")))
+
+    sts = wait_for(lambda: cluster.client.get(StatefulSet, "user", "lab"), msg="sts")
+    c = sts.spec.template.spec.containers[0]
+    assert c.resources.requests[TPU_RESOURCE] == "4"
+    env_d = c.env_dict()
+    assert env_d["JAX_PLATFORMS"] == "tpu"
+    assert env_d["TPU_ACCELERATOR_TYPE"] == "v5e-4"
+    assert sts.spec.template.spec.node_selector[
+        "cloud.google.com/gke-tpu-accelerator"
+    ] == "tpu-v5-lite-podslice"
+
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(cluster.client.get(Notebook, "user", "lab")),
+        msg="mesh ready",
+    )
+    assert nb.status.tpu.hosts == 1
+    assert nb.status.tpu.chips_visible == 4
+    assert nb.status.tpu.chips_expected == 4
+
+
+def test_tpu_multihost_v5p32(env):
+    """BASELINE config: multi-host v5p-32 via headless service + ordinal env."""
+    cluster, mgr = env
+    cluster.add_tpu_pool("v5p-pool", "v5p", "2x2x4")
+    cluster.client.create(
+        mk_notebook("train", tpu=TPUSpec(accelerator="v5p", topology="2x2x4"))
+    )
+    sts = wait_for(lambda: cluster.client.get(StatefulSet, "user", "train"), msg="sts")
+    assert sts.spec.replicas == 4
+    assert sts.spec.service_name == "train-hosts"
+    c = sts.spec.template.spec.containers[0]
+    env_d = c.env_dict()
+    assert env_d["JAX_COORDINATOR_ADDRESS"].startswith("train-0.train-hosts.user.svc")
+    assert env_d["JAX_NUM_PROCESSES"] == "4"
+    assert any(e.name == "TPU_WORKER_ID" and e.value_from for e in c.env)
+
+    hosts_svc = cluster.client.get(Service, "user", "train-hosts")
+    assert hosts_svc.spec.cluster_ip == "None"
+
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(cluster.client.get(Notebook, "user", "train")),
+        msg="mesh ready", timeout=15,
+    )
+    assert nb.status.tpu.hosts_ready == 4
+    assert nb.status.tpu.chips_visible == 16
+    assert nb.status.ready_replicas == 4
+    # 4 pods, each on its own host in one pool
+    pods = cluster.client.list(Pod, namespace="user", labels={C.NOTEBOOK_NAME_LABEL: "train"})
+    assert len({p.spec.node_name for p in pods}) == 4
+
+
+def test_stop_annotation_scales_to_zero(env):
+    cluster, mgr = env
+    cluster.add_cpu_pool("cpu", nodes=1)
+    cluster.client.create(mk_notebook("s1"))
+    wait_for(
+        lambda: cluster.client.get(StatefulSet, "user", "s1").status.ready_replicas == 1,
+        msg="ready",
+    )
+    cluster.client.patch(
+        Notebook, "user", "s1",
+        {"metadata": {"annotations": {C.STOP_ANNOTATION: "2024-01-01T00:00:00Z"}}},
+    )
+    wait_for(
+        lambda: cluster.client.get(StatefulSet, "user", "s1").spec.replicas == 0,
+        msg="scaled to 0",
+    )
+    wait_for(
+        lambda: not cluster.client.list(Pod, namespace="user", labels={C.NOTEBOOK_NAME_LABEL: "s1"}),
+        msg="pods gone",
+    )
+    # unstop -> comes back
+    cluster.client.patch(
+        Notebook, "user", "s1",
+        {"metadata": {"annotations": {C.STOP_ANNOTATION: None}}},
+    )
+    wait_for(
+        lambda: cluster.client.get(StatefulSet, "user", "s1").status.ready_replicas == 1,
+        msg="restarted",
+    )
+
+
+def test_restart_annotation_recreates_pods(env):
+    cluster, mgr = env
+    cluster.add_cpu_pool("cpu", nodes=1)
+    cluster.client.create(mk_notebook("r1"))
+    wait_for(
+        lambda: cluster.client.get(StatefulSet, "user", "r1").status.ready_replicas == 1,
+        msg="ready",
+    )
+    uid0 = cluster.client.get(Pod, "user", "r1-0").metadata.uid
+    cluster.client.patch(
+        Notebook, "user", "r1",
+        {"metadata": {"annotations": {C.NOTEBOOK_RESTART_ANNOTATION: "true"}}},
+    )
+
+    def recreated():
+        nb = cluster.client.get(Notebook, "user", "r1")
+        if C.NOTEBOOK_RESTART_ANNOTATION in nb.metadata.annotations:
+            return False
+        p = cluster.client.get(Pod, "user", "r1-0")
+        return p.metadata.uid != uid0
+
+    wait_for(recreated, msg="pod recreated and annotation cleared")
+
+
+def test_user_spec_change_rolls_template(env):
+    cluster, mgr = env
+    cluster.add_cpu_pool("cpu", nodes=1)
+    cluster.client.create(mk_notebook("u1", image="img:1"))
+    wait_for(
+        lambda: cluster.client.get(StatefulSet, "user", "u1").status.ready_replicas == 1,
+        msg="ready",
+    )
+    nb = cluster.client.get(Notebook, "user", "u1")
+    nb.spec.template.spec.containers[0].image = "img:2"
+    cluster.client.update(nb)
+    wait_for(
+        lambda: cluster.client.get(StatefulSet, "user", "u1")
+        .spec.template.spec.containers[0]
+        .image
+        == "img:2",
+        msg="template updated",
+    )
+    wait_for(
+        lambda: cluster.client.get(Pod, "user", "u1-0").spec.containers[0].image == "img:2",
+        msg="pod recreated with new image",
+    )
+
+
+def test_scheduling_failure_event_mirrored_to_notebook(env):
+    """No TPU pool at all -> FailedScheduling surfaces on the Notebook CR."""
+    cluster, mgr = env
+    cluster.client.create(
+        mk_notebook("starved", tpu=TPUSpec(accelerator="v5p", topology="2x2x4"))
+    )
+
+    def mirrored():
+        return [
+            e
+            for e in cluster.client.list(Event, namespace="user")
+            if e.involved_object.kind == "Notebook"
+            and e.involved_object.name == "starved"
+            and e.reason == "FailedScheduling"
+        ]
+
+    events = wait_for(mirrored, msg="mirrored FailedScheduling event", timeout=15)
+    assert "google.com/tpu" in events[0].message
