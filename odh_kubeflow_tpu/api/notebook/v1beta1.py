@@ -53,6 +53,7 @@ class TPUStatus(KubeModel):
     chips_expected: int = 0
     chips_visible: int = 0
     mesh_ready: bool = False
+    first_ready_time: str = ""  # set once; anchors the CR->ready latency metric
 
 
 @dataclass

@@ -22,6 +22,7 @@ from .meta import (
     get_condition,
     now_rfc3339,
     parse_time,
+    rfc3339,
     sanitize_name,
     set_condition,
 )

@@ -8,19 +8,25 @@ from __future__ import annotations
 
 import dataclasses
 import datetime
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from .serde import KubeModel, jfield
 
 
-def now_rfc3339() -> str:
+def rfc3339(ts: float) -> str:
+    """Unix timestamp -> RFC3339 (whole seconds, Z suffix, k8s-style)."""
     return (
-        datetime.datetime.now(datetime.timezone.utc)
+        datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
         .replace(microsecond=0)
         .isoformat()
         .replace("+00:00", "Z")
     )
+
+
+def now_rfc3339() -> str:
+    return rfc3339(_time.time())
 
 
 def parse_time(s: str) -> datetime.datetime:

@@ -2,3 +2,6 @@ from . import constants
 from .config import Config
 from .metrics import NotebookMetrics
 from .notebook import EventMirrorController, NotebookReconciler, hosts_service_name
+from .culling import CullingReconciler
+from .webhook import NotebookWebhook
+from .extension import TPUWorkbenchReconciler

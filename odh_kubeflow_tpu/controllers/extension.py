@@ -1,0 +1,644 @@
+"""TPU workbench extension reconciler.
+
+Re-design of the reference's OpenshiftNotebookReconciler + satellite-object
+builders (reference odh-notebook-controller/controllers/notebook_controller.go
+:178-497, notebook_route.go, notebook_referencegrant.go,
+notebook_kube_rbac_auth.go, notebook_network.go, notebook_rbac.go,
+notebook_dspa_secret.go, notebook_runtime.go) with OpenShift-isms swapped for
+GKE/Gateway-API equivalents:
+
+- Gateway-API HTTPRoute in the CENTRAL namespace (cross-ns backendRef to the
+  user-ns Service) + one shared ReferenceGrant per user namespace,
+- auth sidecar satellites: ServiceAccount, :8443 Service, SAR ConfigMap, and
+  the cluster-scoped auth-delegator ClusterRoleBinding (finalizer-cleaned:
+  cross-namespace/cluster-scoped objects can't ride owner-ref GC),
+- per-notebook NetworkPolicies (notebook port from the controller namespace
+  only; auth port open; probe port open to the controller namespace),
+- CA-bundle ConfigMap assembly (controller-ns source + cluster roots),
+- runtime-images ConfigMap sync and pipeline RBAC/secret wiring,
+- **reconciliation-lock removal**: the final step that lets the core
+  reconciler scale the StatefulSet 0 -> hosts (the webhook<->controller
+  handshake, reference RemoveReconciliationLock :143-174).
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import List, Optional
+
+from ..api.core import ConfigMap, Secret, Service, ServiceAccount, ServicePort
+from ..api.gateway import (
+    GATEWAY_V1,
+    HTTPBackendRef,
+    HTTPPathMatch,
+    HTTPRoute,
+    HTTPRouteMatch,
+    HTTPRouteRule,
+    ParentReference,
+    ReferenceGrant,
+    ReferenceGrantFrom,
+    ReferenceGrantSpec,
+    ReferenceGrantTo,
+)
+from ..api.networking import (
+    NetworkPolicy,
+    NetworkPolicyIngressRule,
+    NetworkPolicyPeer,
+    NetworkPolicyPort,
+)
+from ..api.notebook import Notebook
+from ..api.rbac import ClusterRoleBinding, Role, RoleBinding, RoleRef, Subject
+from ..apimachinery import (
+    AlreadyExistsError,
+    LabelSelector,
+    NotFoundError,
+    sanitize_name,
+)
+from ..cluster.client import retry_on_conflict
+from ..runtime.controller import Request, Result
+from ..runtime.manager import Manager
+from . import constants as C
+from .config import Config
+from .webhook import AUTH_PROXY_PORT, CA_BUNDLE_CONFIGMAP
+
+log = logging.getLogger(__name__)
+
+NOTEBOOK_NAMESPACE_LABEL = "notebook-namespace"
+REFERENCE_GRANT_NAME = "notebook-httproute-access"
+RUNTIME_IMAGES_CONFIGMAP = "pipeline-runtime-images"
+CA_SOURCE_CONFIGMAP = "odh-trusted-ca-bundle"
+KUBE_ROOT_CA_CONFIGMAP = "kube-root-ca.crt"
+PIPELINE_SERVER_SECRET = "pipeline-server-config"
+ELYRA_SECRET_NAME = "ds-pipeline-config"
+PIPELINE_ROLE_NAME = "ds-pipeline-user-access-dspa"
+
+FINALIZERS = (C.ROUTE_FINALIZER, C.REFERENCE_GRANT_FINALIZER, C.AUTH_BINDING_FINALIZER)
+
+
+def route_name(nb: Notebook) -> str:
+    return sanitize_name(f"nb-{nb.metadata.namespace}-{nb.metadata.name}")
+
+
+def auth_service_name(nb_name: str) -> str:
+    return f"{nb_name}-kube-rbac-proxy"
+
+
+def auth_binding_name(nb: Notebook) -> str:
+    return sanitize_name(
+        f"{nb.metadata.name}-rbac-{nb.metadata.namespace}-auth-delegator"
+    )
+
+
+class TPUWorkbenchReconciler:
+    def __init__(self, manager: Manager, config: Optional[Config] = None):
+        self.manager = manager
+        self.client = manager.client
+        self.config = config or Config()
+
+    def setup(self) -> None:
+        def map_route(obj: dict) -> List[tuple]:
+            labels = obj.get("metadata", {}).get("labels", {})
+            name = labels.get(C.NOTEBOOK_NAME_LABEL)
+            ns = labels.get(NOTEBOOK_NAMESPACE_LABEL)
+            return [(ns, name)] if name and ns else []
+
+        def map_ca_source(obj: dict) -> List[tuple]:
+            meta = obj.get("metadata", {})
+            name, ns = meta.get("name"), meta.get("namespace", "")
+            if name == CA_SOURCE_CONFIGMAP and ns == self.config.controller_namespace:
+                # the central custom bundle affects every notebook
+                return [
+                    (nb.metadata.namespace, nb.metadata.name)
+                    for nb in self.client.list(Notebook)
+                ]
+            if name in (KUBE_ROOT_CA_CONFIGMAP, CA_BUNDLE_CONFIGMAP):
+                # namespace-local sources only touch that namespace's notebooks
+                return [
+                    (nb.metadata.namespace, nb.metadata.name)
+                    for nb in self.client.list(Notebook, namespace=ns)
+                ]
+            return []
+
+        (
+            self.manager.builder("tpu-workbench")
+            .for_(Notebook)
+            .owns(ServiceAccount)
+            .owns(Service)
+            .owns(Secret)
+            .owns(ConfigMap)
+            .owns(NetworkPolicy)
+            .owns(RoleBinding)
+            .watches(HTTPRoute, map_route)
+            .watches(ConfigMap, map_ca_source)
+            .complete(self.reconcile)
+        )
+
+    # ================= reconcile =================
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        try:
+            nb = self.client.get(Notebook, req.namespace, req.name)
+        except NotFoundError:
+            return None
+
+        if nb.metadata.deletion_timestamp:
+            self._finalize(nb)
+            return None
+
+        self._ensure_finalizers(nb)
+        self.reconcile_cert_configmap(nb)
+        self.reconcile_network_policies(nb)
+        self.reconcile_runtime_images(nb)
+        if self.config.set_pipeline_rbac:
+            self.reconcile_pipeline_rbac(nb)
+        if self.config.set_pipeline_secret:
+            self.reconcile_elyra_secret(nb)
+        self.reconcile_reference_grant(nb)
+
+        auth = nb.metadata.annotations.get(C.INJECT_AUTH_ANNOTATION) == "true"
+        if auth:
+            self.reconcile_auth_objects(nb)
+        else:
+            self.cleanup_auth_objects(nb)
+        self.reconcile_httproute(nb, auth=auth)
+
+        self.remove_reconciliation_lock(nb)
+        return None
+
+    # ================= finalizers / deletion =================
+
+    def _ensure_finalizers(self, nb: Notebook) -> None:
+        missing = [f for f in FINALIZERS if f not in nb.metadata.finalizers]
+        if not missing:
+            return
+
+        def attempt():
+            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            for f in FINALIZERS:
+                if f not in cur.metadata.finalizers:
+                    cur.metadata.finalizers.append(f)
+            return self.client.update(cur)
+
+        retry_on_conflict(attempt)
+
+    def _finalize(self, nb: Notebook) -> None:
+        """Deletion path (reference :194-369): tear down the cross-namespace /
+        cluster-scoped satellites owner refs can't reach, then drop finalizers."""
+        errors: List[str] = []
+        if C.ROUTE_FINALIZER in nb.metadata.finalizers:
+            try:
+                self.client.delete(
+                    HTTPRoute, self.config.controller_namespace, route_name(nb)
+                )
+            except NotFoundError:
+                pass
+            except Exception as e:  # keep finalizing; retry via requeue
+                errors.append(f"httproute: {e}")
+        if C.REFERENCE_GRANT_FINALIZER in nb.metadata.finalizers:
+            try:
+                self._delete_reference_grant_if_last(nb)
+            except Exception as e:
+                errors.append(f"referencegrant: {e}")
+        if C.AUTH_BINDING_FINALIZER in nb.metadata.finalizers:
+            try:
+                self.client.delete(ClusterRoleBinding, "", auth_binding_name(nb))
+            except NotFoundError:
+                pass
+            except Exception as e:
+                errors.append(f"clusterrolebinding: {e}")
+        if errors:
+            raise RuntimeError("finalization incomplete: " + "; ".join(errors))
+
+        def drop():
+            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            cur.metadata.finalizers = [
+                f for f in cur.metadata.finalizers if f not in FINALIZERS
+            ]
+            return self.client.update(cur)
+
+        try:
+            retry_on_conflict(drop)
+        except NotFoundError:
+            pass
+
+    def _delete_reference_grant_if_last(self, nb: Notebook) -> None:
+        others = [
+            n
+            for n in self.client.list(Notebook, namespace=nb.metadata.namespace)
+            if n.metadata.name != nb.metadata.name and not n.metadata.deletion_timestamp
+        ]
+        if others:
+            return
+        try:
+            self.client.delete(
+                ReferenceGrant, nb.metadata.namespace, REFERENCE_GRANT_NAME
+            )
+        except NotFoundError:
+            pass
+
+    # ================= CA bundle =================
+
+    def reconcile_cert_configmap(self, nb: Notebook) -> None:
+        """Assemble workbench-trusted-ca-bundle from the controller-ns custom
+        bundle + the cluster root CA (reference CreateNotebookCertConfigMap
+        :504-606, incl. light PEM validation)."""
+        parts: List[str] = []
+        for ns, name, key in (
+            (self.config.controller_namespace, CA_SOURCE_CONFIGMAP, "ca-bundle.crt"),
+            (nb.metadata.namespace, KUBE_ROOT_CA_CONFIGMAP, "ca.crt"),
+        ):
+            try:
+                cm = self.client.get(ConfigMap, ns, name)
+            except NotFoundError:
+                continue
+            pem = cm.data.get(key, "")
+            if pem and "BEGIN CERTIFICATE" in pem:
+                parts.append(pem.strip())
+        if not parts:
+            return
+        desired_data = {"ca-bundle.crt": "\n".join(parts) + "\n"}
+        try:
+            cur = self.client.get(ConfigMap, nb.metadata.namespace, CA_BUNDLE_CONFIGMAP)
+            if cur.data != desired_data:
+                cur.data = desired_data
+                self.client.update(cur)
+        except NotFoundError:
+            cm = ConfigMap()
+            cm.metadata.name = CA_BUNDLE_CONFIGMAP
+            cm.metadata.namespace = nb.metadata.namespace
+            cm.metadata.labels = {"app.kubernetes.io/part-of": "tpu-notebooks"}
+            cm.data = desired_data
+            self._create(cm)
+
+    # ================= network policies =================
+
+    def reconcile_network_policies(self, nb: Notebook) -> None:
+        """Reference NewNotebookNetworkPolicy/NewKubeRbacProxyNetworkPolicy
+        (:132-211) + a TPU-native rule: the probe port is reachable from the
+        controller namespace only (the culler probes it)."""
+        ctrl_ns_peer = NetworkPolicyPeer(
+            namespace_selector=LabelSelector(
+                match_labels={"kubernetes.io/metadata.name": self.config.controller_namespace}
+            )
+        )
+        # the Gateway dataplane forwards user traffic from its own namespace —
+        # without this peer the HTTPRoute path is dead for non-auth notebooks
+        gateway_ns_peer = NetworkPolicyPeer(
+            namespace_selector=LabelSelector(
+                match_labels={"kubernetes.io/metadata.name": self.config.gateway_namespace}
+            )
+        )
+        ctrl = NetworkPolicy()
+        ctrl.metadata.name = f"{nb.metadata.name}-ctrl-np"
+        ctrl.metadata.namespace = nb.metadata.namespace
+        ctrl.spec.pod_selector = LabelSelector(
+            match_labels={C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
+        )
+        ctrl.spec.policy_types = ["Ingress"]
+        ctrl.spec.ingress = [
+            NetworkPolicyIngressRule(
+                ports=[NetworkPolicyPort(protocol="TCP", port=C.NOTEBOOK_PORT)],
+                from_=[ctrl_ns_peer, gateway_ns_peer],
+            ),
+            NetworkPolicyIngressRule(
+                ports=[NetworkPolicyPort(protocol="TCP", port=self.config.probe_port)],
+                from_=[ctrl_ns_peer],
+            ),
+            # slice-internal traffic (jax.distributed coordinator + ICI setup)
+            NetworkPolicyIngressRule(
+                ports=[NetworkPolicyPort(protocol="TCP", port=8476)],
+                from_=[
+                    NetworkPolicyPeer(
+                        pod_selector=LabelSelector(
+                            match_labels={C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
+                        )
+                    )
+                ],
+            ),
+        ]
+        ctrl.set_owner(nb)
+        self._create_or_replace_spec(ctrl)
+
+        if nb.metadata.annotations.get(C.INJECT_AUTH_ANNOTATION) == "true":
+            auth_np = NetworkPolicy()
+            auth_np.metadata.name = f"{nb.metadata.name}-kube-rbac-proxy-np"
+            auth_np.metadata.namespace = nb.metadata.namespace
+            auth_np.spec.pod_selector = LabelSelector(
+                match_labels={C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
+            )
+            auth_np.spec.policy_types = ["Ingress"]
+            auth_np.spec.ingress = [
+                NetworkPolicyIngressRule(
+                    ports=[NetworkPolicyPort(protocol="TCP", port=AUTH_PROXY_PORT)]
+                )
+            ]
+            auth_np.set_owner(nb)
+            self._create_or_replace_spec(auth_np)
+
+    # ================= runtime images =================
+
+    def reconcile_runtime_images(self, nb: Notebook) -> None:
+        """Sync ConfigMaps labeled runtime-image in the controller ns into a
+        per-user-ns `pipeline-runtime-images` ConfigMap (ImageStream-list
+        analog, reference notebook_runtime.go:43-152)."""
+        sources = self.client.list(
+            ConfigMap,
+            namespace=self.config.controller_namespace,
+            labels={C.RUNTIME_IMAGE_LABEL: "true"},
+        )
+        data = {}
+        for src in sources:
+            for display_name, meta_json in sorted(src.data.items()):
+                key = _format_key_name(display_name)
+                try:
+                    meta = json.loads(meta_json)
+                except ValueError:
+                    continue
+                data[key] = json.dumps(meta, sort_keys=True)
+        if not data:
+            return
+        try:
+            cur = self.client.get(
+                ConfigMap, nb.metadata.namespace, RUNTIME_IMAGES_CONFIGMAP
+            )
+            if cur.data != data:
+                cur.data = data
+                self.client.update(cur)
+        except NotFoundError:
+            cm = ConfigMap()
+            cm.metadata.name = RUNTIME_IMAGES_CONFIGMAP
+            cm.metadata.namespace = nb.metadata.namespace
+            cm.data = data
+            self._create(cm)
+
+    # ================= pipeline RBAC + Elyra =================
+
+    def reconcile_pipeline_rbac(self, nb: Notebook) -> None:
+        """RoleBinding elyra-pipelines-{name} -> Role ds-pipeline-user-access-
+        dspa, only if the Role exists (reference notebook_rbac.go:89-154)."""
+        try:
+            self.client.get(Role, nb.metadata.namespace, PIPELINE_ROLE_NAME)
+        except NotFoundError:
+            return
+        rb = RoleBinding()
+        rb.metadata.name = f"elyra-pipelines-{nb.metadata.name}"
+        rb.metadata.namespace = nb.metadata.namespace
+        rb.role_ref = RoleRef(kind="Role", name=PIPELINE_ROLE_NAME)
+        rb.subjects = [
+            Subject(
+                kind="ServiceAccount",
+                name=nb.metadata.name,
+                namespace=nb.metadata.namespace,
+            )
+        ]
+        rb.set_owner(nb)
+        self._create(rb)
+
+    def reconcile_elyra_secret(self, nb: Notebook) -> None:
+        """Render the Elyra runtime config from the pipeline server's
+        connection secret (DSPA-extraction analog, reference
+        notebook_dspa_secret.go:189-371)."""
+        try:
+            src = self.client.get(
+                Secret, self.config.controller_namespace, PIPELINE_SERVER_SECRET
+            )
+        except NotFoundError:
+            return
+        cfg = {
+            "display_name": "Data Science Pipeline",
+            "schema_name": "kfp",
+            "metadata": {
+                "tags": [],
+                "display_name": "Data Science Pipeline",
+                "engine": "Argo",
+                "auth_type": "KUBERNETES_SERVICE_ACCOUNT_TOKEN",
+                "api_endpoint": src.string_data.get("api_endpoint", ""),
+                "public_api_endpoint": src.string_data.get("public_api_endpoint", ""),
+                "cos_auth_type": "KUBERNETES_SECRET",
+                "cos_endpoint": src.string_data.get("cos_endpoint", ""),
+                "cos_bucket": src.string_data.get("cos_bucket", ""),
+                "cos_secret": ELYRA_SECRET_NAME,
+                "cos_username": src.string_data.get("cos_username", ""),
+                "cos_password": src.string_data.get("cos_password", ""),
+                "runtime_type": "KUBEFLOW_PIPELINES",
+            },
+        }
+        desired = {"odh_dsp.json": json.dumps(cfg, sort_keys=True)}
+        try:
+            cur = self.client.get(Secret, nb.metadata.namespace, ELYRA_SECRET_NAME)
+            if cur.string_data != desired:
+                cur.string_data = desired
+                self.client.update(cur)
+        except NotFoundError:
+            secret = Secret()
+            secret.metadata.name = ELYRA_SECRET_NAME
+            secret.metadata.namespace = nb.metadata.namespace
+            secret.string_data = desired
+            secret.type = "Opaque"
+            self._create(secret)
+
+    # ================= routing =================
+
+    def reconcile_reference_grant(self, nb: Notebook) -> None:
+        """One shared grant per user namespace: HTTPRoute(central ns) ->
+        Service(user ns) (reference notebook_referencegrant.go:39-126)."""
+        grant = ReferenceGrant()
+        grant.metadata.name = REFERENCE_GRANT_NAME
+        grant.metadata.namespace = nb.metadata.namespace
+        grant.spec = ReferenceGrantSpec(
+            from_=[
+                ReferenceGrantFrom(
+                    group="gateway.networking.k8s.io",
+                    kind="HTTPRoute",
+                    namespace=self.config.controller_namespace,
+                )
+            ],
+            to=[ReferenceGrantTo(group="", kind="Service")],
+        )
+        try:
+            self.client.create(grant)
+        except AlreadyExistsError:
+            pass
+
+    def reconcile_httproute(self, nb: Notebook, auth: bool) -> None:
+        """Central-namespace HTTPRoute with cross-ns backendRef; auth mode
+        retargets the backend to the kube-rbac-proxy service (reference
+        notebook_route.go:50-218 + EnsureConflictingHTTPRouteAbsent :269-324,
+        which here is a plain retarget since the route name is shared)."""
+        route = HTTPRoute()
+        route.metadata.name = route_name(nb)
+        route.metadata.namespace = self.config.controller_namespace
+        route.metadata.labels = {
+            C.NOTEBOOK_NAME_LABEL: nb.metadata.name,
+            NOTEBOOK_NAMESPACE_LABEL: nb.metadata.namespace,
+        }
+        if auth:
+            backend = HTTPBackendRef(
+                kind="Service",
+                name=auth_service_name(nb.metadata.name),
+                namespace=nb.metadata.namespace,
+                port=AUTH_PROXY_PORT,
+            )
+        else:
+            backend = HTTPBackendRef(
+                kind="Service",
+                name=nb.metadata.name,
+                namespace=nb.metadata.namespace,
+                port=80,
+            )
+        route.spec.parent_refs = [
+            ParentReference(
+                group="gateway.networking.k8s.io",
+                kind="Gateway",
+                name=self.config.gateway_name,
+                namespace=self.config.gateway_namespace,
+            )
+        ]
+        route.spec.rules = [
+            HTTPRouteRule(
+                matches=[
+                    HTTPRouteMatch(
+                        path=HTTPPathMatch(
+                            type="PathPrefix",
+                            value=f"/notebook/{nb.metadata.namespace}/{nb.metadata.name}",
+                        )
+                    )
+                ],
+                backend_refs=[backend],
+            )
+        ]
+        # no owner ref: cross-namespace — label-matched, finalizer-cleaned
+        self._create_or_replace_spec(route)
+
+    # ================= auth satellites =================
+
+    def reconcile_auth_objects(self, nb: Notebook) -> None:
+        """ServiceAccount + :8443 Service + SAR ConfigMap + cluster-scoped
+        auth-delegator binding (reference notebook_kube_rbac_auth.go)."""
+        sa = ServiceAccount()
+        sa.metadata.name = nb.metadata.name
+        sa.metadata.namespace = nb.metadata.namespace
+        sa.set_owner(nb)
+        self._create(sa)
+
+        svc = Service()
+        svc.metadata.name = auth_service_name(nb.metadata.name)
+        svc.metadata.namespace = nb.metadata.namespace
+        svc.metadata.annotations = {
+            # cert-manager serving cert (the OpenShift serving-cert analog)
+            "cert-manager.io/issuer": "cluster-ca",
+            "cert-manager.io/secret-name": f"{nb.metadata.name}-tls",
+        }
+        svc.spec.selector = {C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
+        svc.spec.ports = [
+            ServicePort(name="https", port=AUTH_PROXY_PORT, target_port=AUTH_PROXY_PORT)
+        ]
+        svc.set_owner(nb)
+        self._create(svc)
+
+        sar = {
+            "authorization": {
+                "resourceAttributes": {
+                    "apiGroup": "kubeflow.org",
+                    "resource": "notebooks",
+                    "name": nb.metadata.name,
+                    "namespace": nb.metadata.namespace,
+                    "verb": "get",
+                }
+            }
+        }
+        cm = ConfigMap()
+        cm.metadata.name = f"{nb.metadata.name}-kube-rbac-proxy-config"
+        cm.metadata.namespace = nb.metadata.namespace
+        cm.data = {"config-file.yaml": json.dumps(sar, sort_keys=True)}
+        cm.set_owner(nb)
+        self._create_or_replace_spec(cm, field="data")
+
+        crb = ClusterRoleBinding()
+        crb.metadata.name = auth_binding_name(nb)
+        crb.role_ref = RoleRef(kind="ClusterRole", name="system:auth-delegator")
+        crb.subjects = [
+            Subject(
+                kind="ServiceAccount",
+                name=nb.metadata.name,
+                namespace=nb.metadata.namespace,
+            )
+        ]
+        # cluster-scoped: no owner ref possible -> AUTH_BINDING_FINALIZER cleans
+        try:
+            self.client.create(crb)
+        except AlreadyExistsError:
+            pass
+
+    def cleanup_auth_objects(self, nb: Notebook) -> None:
+        """Auth switched off: revoke the delegator binding and remove the
+        orphan proxy Service/ConfigMap (the SA stays — it's the pod identity).
+        Leaving the ClusterRoleBinding would keep tokenreview rights forever."""
+        for cls, ns, name in (
+            (ClusterRoleBinding, "", auth_binding_name(nb)),
+            (Service, nb.metadata.namespace, auth_service_name(nb.metadata.name)),
+            (ConfigMap, nb.metadata.namespace, f"{nb.metadata.name}-kube-rbac-proxy-config"),
+            (NetworkPolicy, nb.metadata.namespace, f"{nb.metadata.name}-kube-rbac-proxy-np"),
+        ):
+            try:
+                self.client.delete(cls, ns, name)
+            except NotFoundError:
+                pass
+
+    # ================= the lock =================
+
+    def remove_reconciliation_lock(self, nb: Notebook) -> None:
+        """The handshake's last step: only the webhook's lock value is
+        removed — a user's own stop annotation is never touched (reference
+        RemoveReconciliationLock :143-174 patches it to null with retries)."""
+        if nb.metadata.annotations.get(C.STOP_ANNOTATION) != C.RECONCILIATION_LOCK_VALUE:
+            return
+
+        def attempt():
+            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            if cur.metadata.annotations.get(C.STOP_ANNOTATION) != C.RECONCILIATION_LOCK_VALUE:
+                return cur
+            return self.client.patch(
+                Notebook,
+                nb.metadata.namespace,
+                nb.metadata.name,
+                {"metadata": {"annotations": {C.STOP_ANNOTATION: None}}},
+            )
+
+        retry_on_conflict(attempt)
+
+    # ================= helpers =================
+
+    def _create(self, obj) -> None:
+        try:
+            self.client.create(obj)
+        except AlreadyExistsError:
+            pass
+
+    def _create_or_replace_spec(self, desired, field: str = "spec") -> None:
+        cls = type(desired)
+        try:
+            cur = self.client.get(cls, desired.metadata.namespace, desired.metadata.name)
+        except NotFoundError:
+            self._create(desired)
+            return
+        cur_val = getattr(cur, field)
+        des_val = getattr(desired, field)
+        cur_dict = cur_val.to_dict() if hasattr(cur_val, "to_dict") else cur_val
+        des_dict = des_val.to_dict() if hasattr(des_val, "to_dict") else des_val
+        changed = False
+        if cur_dict != des_dict:
+            setattr(cur, field, des_val)
+            changed = True
+        if desired.metadata.labels and cur.metadata.labels != desired.metadata.labels:
+            cur.metadata.labels = desired.metadata.labels
+            changed = True
+        if changed:
+            self.client.update(cur)
+
+
+def _format_key_name(display_name: str) -> str:
+    """'Tensorflow 2.x' -> 'tensorflow_2.x.json' (reference formatKeyName
+    :174-182)."""
+    sanitized = display_name.lower().replace(" ", "_").replace("/", "_")
+    return f"{sanitized}.json"

@@ -18,6 +18,7 @@ updateNotebookStatus :299-374, setPrefixEnvVar :417-431):
 from __future__ import annotations
 
 import logging
+import time
 from typing import List, Optional
 
 from ..api.apps import StatefulSet
@@ -35,9 +36,11 @@ from ..api.core import (
 )
 from ..api.notebook import Notebook, TPUStatus
 from ..apimachinery import (
+    AlreadyExistsError,
     Condition,
     NotFoundError,
     now_rfc3339,
+    parse_time,
 )
 from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
@@ -100,11 +103,10 @@ class NotebookReconciler:
         hosts = shape.hosts if shape else 1
         sts.spec.replicas = 0 if stopped else hosts
         sts.spec.selector.match_labels = {C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
-        sts.spec.service_name = (
-            hosts_service_name(nb.metadata.name)
-            if shape and shape.multi_host
-            else nb.metadata.name
-        )
+        # Always the headless service: per-pod DNS records only exist behind a
+        # headless Service, and the culler's TPU probe needs {name}-0.{svc}
+        # even for single-host slices (a ClusterIP service can't resolve pods)
+        sts.spec.service_name = hosts_service_name(nb.metadata.name)
         sts.spec.pod_management_policy = "Parallel"  # slice hosts boot together
 
         template = sts.spec.template
@@ -160,11 +162,7 @@ class NotebookReconciler:
                 container.resources = ResourceRequirements()
             container.resources.requests[TPU_RESOURCE] = str(shape.chips_per_host)
             container.resources.limits[TPU_RESOURCE] = str(shape.chips_per_host)
-            svc = (
-                hosts_service_name(nb.metadata.name)
-                if shape.multi_host
-                else nb.metadata.name
-            )
+            svc = hosts_service_name(nb.metadata.name)
             existing = {e.name for e in container.env}
             for ev in tpu_env(
                 shape,
@@ -236,8 +234,7 @@ class NotebookReconciler:
         shape = self.plan(nb)
         self._reconcile_statefulset(nb, shape)
         self._reconcile_service(nb, self.generate_service(nb))
-        if shape is not None and shape.multi_host:
-            self._reconcile_service(nb, self.generate_hosts_service(nb))
+        self._reconcile_service(nb, self.generate_hosts_service(nb))
         self._update_status(nb, shape)
         self._handle_restart(nb)
         return None
@@ -343,6 +340,7 @@ class NotebookReconciler:
 
         if shape is not None:
             status.tpu = status.tpu or TPUStatus()
+            was_mesh_ready = status.tpu.mesh_ready
             status.tpu.accelerator = shape.accelerator
             status.tpu.topology = shape.topology
             status.tpu.hosts = shape.hosts
@@ -354,6 +352,15 @@ class NotebookReconciler:
             if status.tpu.chips_visible < ready_pods * shape.chips_per_host:
                 status.tpu.chips_visible = ready_pods * shape.chips_per_host
             status.tpu.mesh_ready = ready_pods == shape.hosts and shape.hosts > 0
+            if status.tpu.mesh_ready and not status.tpu.first_ready_time:
+                # the north-star metric: CR creation -> FIRST slice readiness
+                # (cull/restart cycles must not re-observe days-long values)
+                status.tpu.first_ready_time = now_rfc3339()
+                try:
+                    created = parse_time(nb.metadata.creation_timestamp).timestamp()
+                    self.metrics.slice_ready_seconds.observe(time.time() - created)
+                except (ValueError, TypeError):
+                    pass
 
         def write():
             cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
@@ -463,6 +470,19 @@ class EventMirrorController:
         mirrored.last_timestamp = ev.last_timestamp or now_rfc3339()
         try:
             self.client.create(mirrored)
-        except Exception:
-            pass  # already mirrored (AlreadyExists) or event GC race
+        except AlreadyExistsError:
+            # source event recurred (count bumped): keep the mirror current
+            try:
+                self.client.patch(
+                    Event,
+                    mirrored.metadata.namespace,
+                    mirrored.metadata.name,
+                    {
+                        "count": ev.count,
+                        "message": ev.message,
+                        "lastTimestamp": mirrored.last_timestamp,
+                    },
+                )
+            except NotFoundError:
+                pass  # event-GC race: mirror TTL'd between create and patch
         return None

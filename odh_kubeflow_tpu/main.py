@@ -1,0 +1,74 @@
+"""Composition root: wire the full notebook operator onto a store.
+
+Single manager, single binary — SURVEY §7's deliberate simplification of the
+reference's two-process split (notebook-controller/main.go:58-148 + odh
+main.go:117-245 watch the same CR from two managers; here one manager hosts
+all four controllers and the webhook registers into the store's admission
+chain)."""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from .cluster.store import Store
+from .controllers import (
+    Config,
+    CullingReconciler,
+    EventMirrorController,
+    NotebookReconciler,
+    NotebookWebhook,
+    TPUWorkbenchReconciler,
+)
+from .controllers.metrics import NotebookMetrics
+from .runtime.manager import Manager
+
+log = logging.getLogger(__name__)
+
+
+def build_manager(
+    store: Store,
+    config: Optional[Config] = None,
+    leader_election: bool = False,
+    http_get=None,
+) -> Manager:
+    """Everything the two reference managers run, on one Manager."""
+    config = config or Config.from_env()
+    mgr = Manager(
+        store,
+        leader_election=leader_election,
+        leader_election_id="tpu-notebook-controller",
+    )
+    metrics = NotebookMetrics(mgr.metrics, mgr.client)
+
+    NotebookWebhook(mgr.client, config).register(store)
+    NotebookReconciler(mgr, config, metrics=metrics).setup()
+    EventMirrorController(mgr).setup()
+    TPUWorkbenchReconciler(mgr, config).setup()
+    CullingReconciler(mgr, config, http_get=http_get, metrics=metrics).setup()
+    return mgr
+
+
+def main() -> None:  # pragma: no cover - thin CLI shell
+    logging.basicConfig(level=logging.INFO)
+    from .cluster.sim import SimCluster
+
+    config = Config.from_env()
+    cluster = SimCluster().start()
+    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    mgr.start()
+    log.info("tpu-notebook-controller running (in-process cluster)")
+    try:
+        import signal
+        import threading
+
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        stop.wait()
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    main()

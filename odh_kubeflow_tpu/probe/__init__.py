@@ -1,0 +1,7 @@
+from .agent import (
+    JaxTPUMonitor,
+    KernelState,
+    NotebookAgent,
+    SimTPUMonitor,
+    TPUMonitor,
+)

@@ -1,0 +1,230 @@
+"""In-pod notebook agent: readiness, TPU utilization, and activity probes.
+
+The TPU-native replacement for the reference's idleness signal. The reference
+culler GETs the notebook's Jupyter REST API (/api/kernels, /api/terminals)
+through the cluster Service (reference culling_controller.go:243-313) — a
+GPU-era proxy for "is the user doing anything". On TPUs the expensive resource
+is the slice, so this agent adds what nvidia-smi-polling would have been:
+
+- GET /tpu/readiness   -> {"chips_visible", "chips_expected", "ready",
+                           "process_id"} from jax.local_devices() — the
+  controller's readiness gate counts every host's report (SURVEY §7 hard
+  part (a)),
+- GET /tpu/utilization -> {"duty_cycle", "last_busy"} so the culler only
+  reclaims slices that are BOTH Jupyter-idle and TPU-idle,
+- GET /api/kernels, /api/terminals -> Jupyter-compatible JSON (served by the
+  real Jupyter in production; by this agent in the sim and in bare
+  training pods that run no Jupyter).
+
+The agent runs next to (or inside) the notebook process; `TPUMonitor` is the
+seam between real JAX introspection and scripted test state.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+
+from ..apimachinery import rfc3339 as _utc
+
+
+class TPUMonitor:
+    """Interface: what the agent knows about the local TPU host."""
+
+    def chips_visible(self) -> int:
+        raise NotImplementedError
+
+    def chips_expected(self) -> int:
+        raise NotImplementedError
+
+    def process_id(self) -> int:
+        return 0
+
+    def duty_cycle(self) -> float:
+        """0.0-1.0 utilization over the recent window."""
+        raise NotImplementedError
+
+    def last_busy(self) -> float:
+        """Unix timestamp of last observed TPU activity."""
+        raise NotImplementedError
+
+
+class JaxTPUMonitor(TPUMonitor):
+    """Real implementation: introspects the local JAX runtime.
+
+    Duty cycle derives from activity pings: the workbench workload library
+    (odh_kubeflow_tpu.parallel) calls record_activity() around device work,
+    and a window average approximates utilization. Chip visibility is always
+    live truth from jax.local_devices()."""
+
+    def __init__(self, chips_expected: Optional[int] = None, window_s: float = 120.0):
+        import os
+
+        self._expected = chips_expected
+        if self._expected is None:
+            self._expected = int(os.environ.get("NB_TPU_CHIPS_EXPECTED", "0") or 0)
+        self._hosts = int(os.environ.get("NB_TPU_HOSTS", "1") or 1)
+        self._process_id = int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+        self._window_s = window_s
+        self._activity: List[Tuple[float, float]] = []  # (timestamp, busy seconds)
+        self._last_busy = 0.0
+        self._lock = threading.Lock()
+
+    def record_activity(self, busy_seconds: float = 0.0) -> None:
+        now = time.time()
+        with self._lock:
+            self._last_busy = now
+            self._activity.append((now, busy_seconds))
+            cutoff = now - self._window_s
+            self._activity = [(t, b) for t, b in self._activity if t >= cutoff]
+
+    def chips_visible(self) -> int:
+        try:
+            import jax
+
+            return len(jax.local_devices())
+        except Exception:
+            return 0
+
+    def chips_expected(self) -> int:
+        if self._expected:
+            return max(1, self._expected // max(1, self._hosts))
+        return self.chips_visible()
+
+    def process_id(self) -> int:
+        return self._process_id
+
+    def duty_cycle(self) -> float:
+        with self._lock:
+            if not self._activity:
+                return 0.0
+            busy = sum(b for _, b in self._activity)
+            return min(1.0, busy / self._window_s)
+
+    def last_busy(self) -> float:
+        with self._lock:
+            return self._last_busy
+
+
+@dataclass
+class SimTPUMonitor(TPUMonitor):
+    """Scriptable monitor for tests/benchmarks."""
+
+    chips: int = 4
+    expected: int = 4
+    pid: int = 0
+    duty: float = 0.0
+    last_busy_ts: float = 0.0
+
+    def chips_visible(self) -> int:
+        return self.chips
+
+    def chips_expected(self) -> int:
+        return self.expected
+
+    def process_id(self) -> int:
+        return self.pid
+
+    def duty_cycle(self) -> float:
+        return self.duty
+
+    def last_busy(self) -> float:
+        return self.last_busy_ts
+
+
+@dataclass
+class KernelState:
+    """Scriptable Jupyter state (what /api/kernels reports)."""
+
+    kernels: List[Dict[str, Any]] = field(default_factory=list)
+    terminals: List[Dict[str, Any]] = field(default_factory=list)
+
+    def set_busy(self) -> None:
+        self.kernels = [
+            {"id": "k0", "execution_state": "busy", "last_activity": _utc(time.time())}
+        ]
+
+    def set_idle(self, last_activity: float) -> None:
+        self.kernels = [
+            {"id": "k0", "execution_state": "idle", "last_activity": _utc(last_activity)}
+        ]
+
+
+class NotebookAgent:
+    """The HTTP server. serve() returns (host, port, close) — the kubelet
+    sim's PodDecision.serve contract — and works identically as a standalone
+    process entrypoint (python -m odh_kubeflow_tpu.probe)."""
+
+    def __init__(
+        self,
+        monitor: Optional[TPUMonitor] = None,
+        kernels: Optional[KernelState] = None,
+        base_path: str = "",
+    ):
+        self.monitor = monitor or JaxTPUMonitor()
+        self.kernels = kernels or KernelState()
+        self.base_path = base_path.rstrip("/")
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    def routes(self, path: str) -> Optional[Dict[str, Any]]:
+        if self.base_path and path.startswith(self.base_path):
+            path = path[len(self.base_path) :] or "/"
+        path = path.split("?")[0]
+        if path.endswith("/api/kernels"):
+            return {"_raw": self.kernels.kernels}
+        if path.endswith("/api/terminals"):
+            return {"_raw": self.kernels.terminals}
+        if path.endswith("/tpu/readiness"):
+            visible = self.monitor.chips_visible()
+            expected = self.monitor.chips_expected()
+            return {
+                "chips_visible": visible,
+                "chips_expected": expected,
+                "ready": expected > 0 and visible >= expected,
+                "process_id": self.monitor.process_id(),
+            }
+        if path.endswith("/tpu/utilization"):
+            lb = self.monitor.last_busy()
+            return {
+                "duty_cycle": self.monitor.duty_cycle(),
+                "last_busy": _utc(lb) if lb else "",
+            }
+        if path.endswith("/healthz"):
+            return {"status": "ok"}
+        return None
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                payload = agent.routes(self.path)
+                if payload is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps(
+                    payload["_raw"] if "_raw" in payload else payload
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(
+            target=self._server.serve_forever, name="notebook-agent", daemon=True
+        ).start()
+        return (host, self._server.server_port, self.close)
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None

@@ -1,0 +1,84 @@
+"""Minimal OpenTelemetry-shaped tracing, from scratch.
+
+The reference traces only the webhook (reference odh notebook_webhook.go:29-31,
+70-72, spans at :358-365,509-510, span events at :834,850,883), with a no-op
+global provider in production and an in-memory exporter in tests
+(opentelemetry_test.go:26-77). Same surface here."""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class SpanEvent:
+    name: str
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    timestamp: float = 0.0
+
+
+@dataclass
+class Span:
+    name: str
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    events: List[SpanEvent] = field(default_factory=list)
+    parent: Optional["Span"] = None
+    start_time: float = 0.0
+    end_time: float = 0.0
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        self.events.append(SpanEvent(name, attributes, time.time()))
+
+    def end(self) -> None:
+        self.end_time = time.time()
+
+
+class Tracer:
+    """No-op by default; attach an InMemoryExporter to record."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.exporter: Optional["InMemoryExporter"] = None
+        self._local = threading.local()
+
+    def start_span(self, name: str, **attributes: Any) -> "SpanContext":
+        parent = getattr(self._local, "current", None)
+        span = Span(name=name, attributes=dict(attributes), parent=parent,
+                    start_time=time.time())
+        return SpanContext(self, span)
+
+    def _record(self, span: Span) -> None:
+        if self.exporter is not None:
+            self.exporter.spans.append(span)
+
+
+class SpanContext:
+    def __init__(self, tracer: Tracer, span: Span):
+        self.tracer = tracer
+        self.span = span
+
+    def __enter__(self) -> Span:
+        self.tracer._local.current = self.span
+        return self.span
+
+    def __exit__(self, *exc) -> None:
+        self.span.end()
+        self.tracer._local.current = self.span.parent
+        self.tracer._record(self.span)
+
+
+class InMemoryExporter:
+    def __init__(self) -> None:
+        self.spans: List[Span] = []
+
+    def by_name(self, name: str) -> List[Span]:
+        return [s for s in self.spans if s.name == name]
+
+
+# module-level default, like the OTel global tracer provider
+webhook_tracer = Tracer("notebook-webhook")

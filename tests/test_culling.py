@@ -1,0 +1,196 @@
+"""Culling: annotation state machine, Jupyter+TPU dual idleness signal,
+cull -> slice freed, reactivation. Probes travel real HTTP sockets to the
+in-pod agent served by the kubelet sim."""
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.core import Container, Pod
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.cluster import PodDecision, SimCluster
+from odh_kubeflow_tpu.controllers import (
+    Config,
+    CullingReconciler,
+    NotebookReconciler,
+    constants as C,
+)
+from odh_kubeflow_tpu.probe import KernelState, NotebookAgent, SimTPUMonitor
+from odh_kubeflow_tpu.runtime import Manager
+
+FAST = Config(
+    enable_culling=True,
+    cull_idle_time_min=1.5 / 60.0,  # 1.5 s idle threshold
+    idleness_check_period_min=0.1 / 60.0,  # 0.1 s cadence
+)
+
+
+@pytest.fixture()
+def env():
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("pool", "v5e", "2x2")
+    cluster.add_cpu_pool("cpu", nodes=1)
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, FAST).setup()
+    CullingReconciler(mgr, FAST, http_get=cluster.http_get).setup()
+
+    # every notebook pod runs a real agent; tests script its state
+    agents = {}
+
+    def behavior(pod):
+        # NB: called on every kubelet reconcile -> must reuse one agent per
+        # pod uid or the served state and the test's handle diverge
+        nb_name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
+        if not nb_name:
+            return None
+        cache_key = (pod.metadata.name, pod.metadata.uid)
+        if cache_key not in agents:
+            kernels = KernelState()
+            kernels.set_idle(time.time())
+            monitor = SimTPUMonitor(chips=4, expected=4, duty=0.0)
+            agents[cache_key] = NotebookAgent(monitor=monitor, kernels=kernels)
+            agents[pod.metadata.name] = (kernels, monitor)
+        agent = agents[cache_key]
+        return PodDecision(serve=lambda p: agent.serve())
+
+    cluster.add_pod_behavior(behavior)
+    mgr.start()
+    yield cluster, mgr, agents
+    mgr.stop()
+    cluster.stop()
+
+
+def mk_nb(name, tpu=None):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = "user"
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    if tpu:
+        nb.spec.tpu = tpu
+    return nb
+
+
+def wait_for(fn, timeout=10, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def get_nb(cluster, name):
+    return cluster.client.get(Notebook, "user", name)
+
+
+def test_idle_notebook_is_culled_and_annotations_tracked(env):
+    cluster, mgr, agents = env
+    cluster.client.create(mk_nb("sleepy"))
+    # annotations initialize
+    wait_for(
+        lambda: C.LAST_ACTIVITY_ANNOTATION in get_nb(cluster, "sleepy").metadata.annotations
+        or C.STOP_ANNOTATION in get_nb(cluster, "sleepy").metadata.annotations,
+        msg="activity annotation initialized",
+    )
+    # idle kernels + no TPU -> culled after the 0.5s threshold
+    wait_for(
+        lambda: C.STOP_ANNOTATION in get_nb(cluster, "sleepy").metadata.annotations,
+        msg="culled",
+    )
+    # slice freed
+    wait_for(
+        lambda: not cluster.client.list(
+            Pod, namespace="user", labels={C.NOTEBOOK_NAME_LABEL: "sleepy"}
+        ),
+        msg="pods gone",
+    )
+    # culling removed the activity annotations once stopped
+    wait_for(
+        lambda: C.LAST_ACTIVITY_ANNOTATION
+        not in get_nb(cluster, "sleepy").metadata.annotations,
+        msg="activity annotations removed",
+    )
+
+
+def test_busy_kernel_prevents_culling(env):
+    cluster, mgr, agents = env
+    cluster.client.create(mk_nb("worker"))
+    wait_for(lambda: "worker-0" in agents, msg="pod up")
+    agents["worker-0"][0].set_busy()
+    time.sleep(2.5)  # several cull windows
+    assert C.STOP_ANNOTATION not in get_nb(cluster, "worker").metadata.annotations
+
+
+def test_tpu_busy_blocks_cull_despite_idle_kernels(env):
+    """The TPU-native signal: kernels idle, but the slice is training."""
+    cluster, mgr, agents = env
+    cluster.client.create(mk_nb("trainer", tpu=TPUSpec(accelerator="v5e", topology="2x2")))
+    wait_for(lambda: "trainer-0" in agents, msg="pod up")
+    kernels, monitor = agents["trainer-0"]
+    kernels.set_idle(time.time() - 3600)  # kernels idle for an hour
+    monitor.duty = 0.9  # slice is hot
+    monitor.last_busy_ts = time.time()
+    time.sleep(2.5)
+    assert C.STOP_ANNOTATION not in get_nb(cluster, "trainer").metadata.annotations
+
+    # slice cools down -> cull proceeds
+    monitor.duty = 0.0
+    wait_for(
+        lambda: C.STOP_ANNOTATION in get_nb(cluster, "trainer").metadata.annotations,
+        msg="culled after TPU idle",
+        timeout=15,
+    )
+
+
+def test_unstop_restarts_cull_cycle(env):
+    cluster, mgr, agents = env
+    cluster.client.create(mk_nb("cycle"))
+    wait_for(
+        lambda: C.STOP_ANNOTATION in get_nb(cluster, "cycle").metadata.annotations,
+        msg="culled once",
+    )
+    old_handle = agents.get("cycle-0")
+    # user restarts the notebook (dashboard removes the stop annotation)
+    cluster.client.patch(
+        Notebook, "user", "cycle",
+        {"metadata": {"annotations": {C.STOP_ANNOTATION: None}}},
+    )
+    # the RECREATED pod gets a fresh agent; wait for it, then hold it busy
+    wait_for(
+        lambda: agents.get("cycle-0") not in (None, old_handle), msg="new pod back"
+    )
+    agents["cycle-0"][0].set_busy()
+    wait_for(
+        lambda: get_nb(cluster, "cycle").status.ready_replicas == 1, msg="ready again"
+    )
+    time.sleep(1.0)
+    assert C.STOP_ANNOTATION not in get_nb(cluster, "cycle").metadata.annotations
+
+
+def test_probe_failure_defers_culling(env):
+    """Jupyter probe unreachable -> check timestamp advances, no cull."""
+    cluster, mgr, agents = env
+
+    # a notebook whose pod serves nothing (no agent behavior matches)
+    nb = Notebook()
+    nb.metadata.name = "dark"
+    nb.metadata.namespace = "other-ns"  # behavior keyed on label still matches...
+    nb.spec.template.spec.containers = [Container(name="dark", image="jax:1")]
+    # override: create in user ns but without agent by removing behavior match
+    nb.metadata.namespace = "user"
+    nb.metadata.labels["no-agent"] = "true"
+    cluster.client.create(nb)
+    # kubelet behavior serves an agent for every labeled pod; kill its server
+    wait_for(
+        lambda: cluster.kubelet.server_for("user", "dark-0") is not None,
+        msg="server registered",
+    )
+    # stop the server so probes fail (DNS still resolves to a dead port)
+    key = "user/dark-0"
+    with cluster.kubelet._lock:
+        entry = cluster.kubelet._servers.get(key)
+    assert entry
+    entry[3]()
+    time.sleep(2.5)
+    nb = get_nb(cluster, "dark")
+    assert C.STOP_ANNOTATION not in nb.metadata.annotations
+    assert C.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION in nb.metadata.annotations

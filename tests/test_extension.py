@@ -1,0 +1,289 @@
+"""Extension reconciler + full-stack e2e: webhook lock -> satellites ->
+lock removal -> slice up; routing/auth/netpol/CA/finalizer semantics."""
+import json
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.apps import StatefulSet
+from odh_kubeflow_tpu.api.core import ConfigMap, Pod, Secret, Service, ServiceAccount, Container
+from odh_kubeflow_tpu.api.gateway import HTTPRoute, ReferenceGrant
+from odh_kubeflow_tpu.api.networking import NetworkPolicy
+from odh_kubeflow_tpu.api.rbac import ClusterRoleBinding, Role, RoleBinding
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.apimachinery import NotFoundError
+from odh_kubeflow_tpu.cluster import SimCluster
+from odh_kubeflow_tpu.controllers import Config, constants as C
+from odh_kubeflow_tpu.controllers.extension import (
+    REFERENCE_GRANT_NAME,
+    RUNTIME_IMAGES_CONFIGMAP,
+    auth_binding_name,
+    route_name,
+)
+from odh_kubeflow_tpu.main import build_manager
+
+CTRL_NS = "tpu-notebooks-system"
+
+
+@pytest.fixture()
+def env():
+    cluster = SimCluster().start()
+    cluster.add_cpu_pool("cpu", nodes=2)
+    cluster.add_tpu_pool("v5e", "v5e", "2x2")
+    config = Config(controller_namespace=CTRL_NS, set_pipeline_rbac=True,
+                    set_pipeline_secret=True)
+    mgr = build_manager(cluster.store, config)
+    mgr.start()
+    yield cluster, mgr, config
+    mgr.stop()
+    cluster.stop()
+
+
+def mk_nb(name, ns="user", annotations=None, tpu=None):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    nb.metadata.annotations = dict(annotations or {})
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    if tpu:
+        nb.spec.tpu = tpu
+    return nb
+
+
+def wait_for(fn, timeout=10, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+        except NotFoundError:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def test_full_lifecycle_lock_handshake(env):
+    """The reference's signature flow (SURVEY §3.2): webhook locks at CREATE,
+    STS starts at 0, extension builds satellites and removes the lock, STS
+    scales up, pods run."""
+    cluster, mgr, config = env
+    created = cluster.client.create(mk_nb("wb", tpu=TPUSpec(accelerator="v5e", topology="2x2")))
+    # webhook injected the lock at admission
+    assert created.metadata.annotations[C.STOP_ANNOTATION] == C.RECONCILIATION_LOCK_VALUE
+
+    # extension removes the lock once satellites exist -> slice comes up
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(cluster.client.get(Notebook, "user", "wb")),
+        msg="mesh ready after lock removal", timeout=15,
+    )
+    assert C.STOP_ANNOTATION not in nb.metadata.annotations
+    assert set(nb.metadata.finalizers) >= {
+        C.ROUTE_FINALIZER, C.REFERENCE_GRANT_FINALIZER, C.AUTH_BINDING_FINALIZER
+    }
+
+    # satellites
+    route = cluster.client.get(HTTPRoute, CTRL_NS, route_name(nb))
+    assert route.spec.rules[0].matches[0].path.value == "/notebook/user/wb"
+    backend = route.spec.rules[0].backend_refs[0]
+    assert backend.name == "wb" and backend.namespace == "user" and backend.port == 80
+    assert cluster.client.get(ReferenceGrant, "user", REFERENCE_GRANT_NAME)
+    nps = cluster.client.list(NetworkPolicy, namespace="user")
+    assert any(np.metadata.name == "wb-ctrl-np" for np in nps)
+
+
+def test_user_stop_annotation_not_removed(env):
+    """The lock remover must never unstop a USER-stopped notebook."""
+    cluster, mgr, config = env
+    cluster.client.create(mk_nb("stopped"))
+    wait_for(
+        lambda: C.STOP_ANNOTATION
+        not in cluster.client.get(Notebook, "user", "stopped").metadata.annotations,
+        msg="lock removed",
+    )
+    # user stops it explicitly (timestamp value, not the lock value)
+    cluster.client.patch(
+        Notebook, "user", "stopped",
+        {"metadata": {"annotations": {C.STOP_ANNOTATION: "2026-07-29T10:00:00Z"}}},
+    )
+    time.sleep(1.0)
+    nb = cluster.client.get(Notebook, "user", "stopped")
+    assert nb.metadata.annotations[C.STOP_ANNOTATION] == "2026-07-29T10:00:00Z"
+
+
+def test_auth_mode_objects_and_route_retarget(env):
+    cluster, mgr, config = env
+    cluster.client.create(mk_nb("secure", annotations={C.INJECT_AUTH_ANNOTATION: "true"}))
+    wait_for(
+        lambda: cluster.client.get(Service, "user", "secure-kube-rbac-proxy"),
+        msg="auth service",
+    )
+    assert cluster.client.get(ServiceAccount, "user", "secure")
+    sar_cm = cluster.client.get(ConfigMap, "user", "secure-kube-rbac-proxy-config")
+    sar = json.loads(sar_cm.data["config-file.yaml"])
+    attrs = sar["authorization"]["resourceAttributes"]
+    assert attrs["name"] == "secure" and attrs["verb"] == "get"
+    nb = cluster.client.get(Notebook, "user", "secure")
+    crb = cluster.client.get(ClusterRoleBinding, "", auth_binding_name(nb))
+    assert crb.role_ref.name == "system:auth-delegator"
+    # route targets the proxy
+    route = wait_for(
+        lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(nb)), msg="route"
+    )
+    backend = route.spec.rules[0].backend_refs[0]
+    assert backend.name == "secure-kube-rbac-proxy" and backend.port == 8443
+    # sidecar injected by webhook
+    sts = cluster.client.get(StatefulSet, "user", "secure")
+    assert any(c.name == "kube-rbac-proxy" for c in sts.spec.template.spec.containers)
+    # auth network policy exists
+    wait_for(
+        lambda: cluster.client.get(NetworkPolicy, "user", "secure-kube-rbac-proxy-np"),
+        msg="auth netpol",
+    )
+
+    # switching auth OFF retargets the route back (notebook is running ->
+    # update-blocking applies to podspec, but annotations flow)
+    cluster.client.patch(
+        Notebook, "user", "secure",
+        {"metadata": {"annotations": {C.INJECT_AUTH_ANNOTATION: None}}},
+    )
+    wait_for(
+        lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(nb))
+        .spec.rules[0]
+        .backend_refs[0]
+        .port
+        == 80,
+        msg="route retargeted",
+    )
+
+
+def test_deletion_cleans_cross_namespace_objects(env):
+    cluster, mgr, config = env
+    cluster.client.create(mk_nb("temp", annotations={C.INJECT_AUTH_ANNOTATION: "true"}))
+    nb = wait_for(lambda: cluster.client.get(Notebook, "user", "temp"), msg="nb")
+    wait_for(lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(nb)), msg="route")
+    crb_name = auth_binding_name(nb)
+    wait_for(lambda: cluster.client.get(ClusterRoleBinding, "", crb_name), msg="crb")
+
+    cluster.client.delete(Notebook, "user", "temp")
+    wait_for(
+        lambda: _not_found(lambda: cluster.client.get(Notebook, "user", "temp")),
+        msg="notebook finalized away",
+    )
+    assert _not_found(lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(nb)))
+    assert _not_found(lambda: cluster.client.get(ClusterRoleBinding, "", crb_name))
+    assert _not_found(
+        lambda: cluster.client.get(ReferenceGrant, "user", REFERENCE_GRANT_NAME)
+    )
+
+
+def test_reference_grant_shared_until_last_notebook(env):
+    cluster, mgr, config = env
+    cluster.client.create(mk_nb("a1"))
+    cluster.client.create(mk_nb("a2"))
+    wait_for(
+        lambda: cluster.client.get(ReferenceGrant, "user", REFERENCE_GRANT_NAME),
+        msg="grant",
+    )
+    cluster.client.delete(Notebook, "user", "a1")
+    wait_for(
+        lambda: _not_found(lambda: cluster.client.get(Notebook, "user", "a1")),
+        msg="a1 gone",
+    )
+    # grant survives: a2 still needs it
+    assert cluster.client.get(ReferenceGrant, "user", REFERENCE_GRANT_NAME)
+    cluster.client.delete(Notebook, "user", "a2")
+    wait_for(
+        lambda: _not_found(
+            lambda: cluster.client.get(ReferenceGrant, "user", REFERENCE_GRANT_NAME)
+        ),
+        msg="grant removed with last notebook",
+    )
+
+
+def test_ca_bundle_assembled_and_mounted(env):
+    cluster, mgr, config = env
+    src = ConfigMap()
+    src.metadata.name = "odh-trusted-ca-bundle"
+    src.metadata.namespace = CTRL_NS
+    src.data = {"ca-bundle.crt": "-----BEGIN CERTIFICATE-----\nAAA\n-----END CERTIFICATE-----"}
+    cluster.client.create(src)
+    cluster.client.create(mk_nb("certd"))
+    bundle = wait_for(
+        lambda: cluster.client.get(ConfigMap, "user", "workbench-trusted-ca-bundle"),
+        msg="bundle assembled",
+    )
+    assert "BEGIN CERTIFICATE" in bundle.data["ca-bundle.crt"]
+    # webhook mounts it on the next podspec-bearing admission; force one by
+    # stopping/starting (stopped notebooks take updates freely)
+    cluster.client.patch(
+        Notebook, "user", "certd",
+        {"metadata": {"annotations": {C.STOP_ANNOTATION: "x"}}},
+    )
+    nb = cluster.client.get(Notebook, "user", "certd")
+    nb.spec.template.spec.containers[0].image = "jax:2"
+    cluster.client.update(nb)
+    nb = cluster.client.get(Notebook, "user", "certd")
+    assert nb.spec.template.spec.volume("trusted-ca") is not None
+
+
+def test_runtime_images_synced(env):
+    cluster, mgr, config = env
+    src = ConfigMap()
+    src.metadata.name = "runtime-catalog"
+    src.metadata.namespace = CTRL_NS
+    src.metadata.labels = {C.RUNTIME_IMAGE_LABEL: "true"}
+    src.data = {
+        "JAX 0.9 on TPU": json.dumps(
+            {"display_name": "JAX 0.9 on TPU", "metadata": {"image_name": "gcr.io/jax:0.9"}}
+        )
+    }
+    cluster.client.create(src)
+    cluster.client.create(mk_nb("rt"))
+    cm = wait_for(
+        lambda: cluster.client.get(ConfigMap, "user", RUNTIME_IMAGES_CONFIGMAP),
+        msg="runtime images synced",
+    )
+    assert "jax_0.9_on_tpu.json" in cm.data
+
+
+def test_pipeline_rbac_and_elyra_secret(env):
+    cluster, mgr, config = env
+    role = Role()
+    role.metadata.name = "ds-pipeline-user-access-dspa"
+    role.metadata.namespace = "user"
+    cluster.client.create(role)
+    src = Secret()
+    src.metadata.name = "pipeline-server-config"
+    src.metadata.namespace = CTRL_NS
+    src.string_data = {
+        "api_endpoint": "https://dspa.svc:8443",
+        "cos_endpoint": "https://minio.svc",
+        "cos_bucket": "pipelines",
+        "cos_username": "minio",
+        "cos_password": "secret",
+    }
+    cluster.client.create(src)
+    cluster.client.create(mk_nb("pl"))
+    rb = wait_for(
+        lambda: cluster.client.get(RoleBinding, "user", "elyra-pipelines-pl"),
+        msg="pipeline rolebinding",
+    )
+    assert rb.role_ref.name == "ds-pipeline-user-access-dspa"
+    secret = wait_for(
+        lambda: cluster.client.get(Secret, "user", "ds-pipeline-config"),
+        msg="elyra secret",
+    )
+    cfg = json.loads(secret.string_data["odh_dsp.json"])
+    assert cfg["metadata"]["cos_bucket"] == "pipelines"
+    assert cfg["metadata"]["api_endpoint"] == "https://dspa.svc:8443"
+
+
+def _not_found(fn):
+    try:
+        fn()
+        return False
+    except NotFoundError:
+        return True

@@ -1,0 +1,211 @@
+"""Mutating webhook: lock injection, TPU validation, image catalog, CA
+bundle, auth sidecar, update-blocking (the reference's subtlest behavior)."""
+import pytest
+
+from odh_kubeflow_tpu.api.core import ConfigMap, Container
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.apimachinery import AdmissionDeniedError
+from odh_kubeflow_tpu.cluster import Client, Store
+from odh_kubeflow_tpu.controllers import Config, constants as C
+from odh_kubeflow_tpu.controllers.webhook import (
+    AUTH_PROXY_CONTAINER,
+    CA_BUNDLE_CONFIGMAP,
+    IMAGE_CATALOG_CONFIGMAP,
+    NotebookWebhook,
+)
+
+
+@pytest.fixture()
+def env():
+    store = Store()
+    client = Client(store)
+    config = Config(controller_namespace="ctrl-ns")
+    NotebookWebhook(client, config).register(store)
+    return store, client, config
+
+
+def mk_nb(name="nb", ns="user", image="base:1", tpu=None, annotations=None):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = ns
+    nb.metadata.annotations = dict(annotations or {})
+    nb.spec.template.spec.containers = [Container(name=name, image=image)]
+    if tpu:
+        nb.spec.tpu = tpu
+    return nb
+
+
+def test_create_injects_lock(env):
+    store, client, _ = env
+    created = client.create(mk_nb())
+    assert created.metadata.annotations[C.STOP_ANNOTATION] == C.RECONCILIATION_LOCK_VALUE
+
+
+def test_invalid_tpu_rejected_at_admission(env):
+    store, client, _ = env
+    with pytest.raises(AdmissionDeniedError, match="spec.tpu invalid"):
+        client.create(mk_nb(tpu=TPUSpec(accelerator="v5p", topology="3x5")))
+    with pytest.raises(AdmissionDeniedError, match="runtime"):
+        client.create(mk_nb(tpu=TPUSpec(accelerator="v5e", topology="2x2", runtime="cuda")))
+
+
+def test_image_resolved_from_catalog(env):
+    store, client, _ = env
+    catalog = ConfigMap()
+    catalog.metadata.name = IMAGE_CATALOG_CONFIGMAP
+    catalog.metadata.namespace = "ctrl-ns"
+    catalog.data = {"jax-notebook:2026a": "gcr.io/wb/jax-notebook@sha256:abc"}
+    client.create(catalog)
+    created = client.create(
+        mk_nb(annotations={C.IMAGE_SELECTION_ANNOTATION: "jax-notebook:2026a"})
+    )
+    assert created.spec.template.spec.containers[0].image == "gcr.io/wb/jax-notebook@sha256:abc"
+
+
+def test_missing_catalog_selection_keeps_image(env):
+    store, client, _ = env
+    created = client.create(
+        mk_nb(annotations={C.IMAGE_SELECTION_ANNOTATION: "ghost:1"})
+    )
+    assert created.spec.template.spec.containers[0].image == "base:1"
+
+
+def test_ca_bundle_mounted_when_present(env):
+    store, client, _ = env
+    cm = ConfigMap()
+    cm.metadata.name = CA_BUNDLE_CONFIGMAP
+    cm.metadata.namespace = "user"
+    cm.data = {"ca-bundle.crt": "-----BEGIN CERTIFICATE-----..."}
+    client.create(cm)
+    created = client.create(mk_nb())
+    podspec = created.spec.template.spec
+    assert podspec.volume("trusted-ca") is not None
+    c = podspec.containers[0]
+    assert any(m.name == "trusted-ca" for m in c.volume_mounts)
+    assert c.env_dict()["SSL_CERT_FILE"].endswith("ca-bundle.crt")
+
+
+def test_auth_sidecar_injection_and_removal(env):
+    store, client, _ = env
+    created = client.create(mk_nb(annotations={C.INJECT_AUTH_ANNOTATION: "true"}))
+    names = [c.name for c in created.spec.template.spec.containers]
+    assert AUTH_PROXY_CONTAINER in names
+    sidecar = created.spec.template.spec.container(AUTH_PROXY_CONTAINER)
+    assert sidecar.resources.requests["cpu"] == "100m"
+    assert created.spec.template.spec.volume("kube-rbac-proxy-config") is not None
+
+    # switch auth off (stopped notebook so update-blocking doesn't interfere)
+    nb = client.get(Notebook, "user", "nb")
+    nb.metadata.annotations.pop(C.INJECT_AUTH_ANNOTATION)
+    nb = client.update(nb)
+    assert AUTH_PROXY_CONTAINER not in [c.name for c in nb.spec.template.spec.containers]
+
+
+def test_auth_sidecar_resource_annotation_validated(env):
+    store, client, _ = env
+    with pytest.raises(AdmissionDeniedError, match="invalid resource quantity"):
+        client.create(
+            mk_nb(
+                annotations={
+                    C.INJECT_AUTH_ANNOTATION: "true",
+                    C.AUTH_SIDECAR_CPU_REQUEST_ANNOTATION: "lots",
+                }
+            )
+        )
+
+
+def test_auth_sidecar_resource_annotation_applied(env):
+    store, client, _ = env
+    created = client.create(
+        mk_nb(
+            annotations={
+                C.INJECT_AUTH_ANNOTATION: "true",
+                C.AUTH_SIDECAR_CPU_LIMIT_ANNOTATION: "250m",
+                C.AUTH_SIDECAR_MEMORY_LIMIT_ANNOTATION: "128Mi",
+            }
+        )
+    )
+    sidecar = created.spec.template.spec.container(AUTH_PROXY_CONTAINER)
+    assert sidecar.resources.limits == {"cpu": "250m", "memory": "128Mi"}
+
+
+def test_update_blocking_webhook_only_drift(env):
+    """A running notebook must not restart because the catalog image moved:
+    podspec reverts and update-pending records the first diff."""
+    store, client, _ = env
+    catalog = ConfigMap()
+    catalog.metadata.name = IMAGE_CATALOG_CONFIGMAP
+    catalog.metadata.namespace = "ctrl-ns"
+    catalog.data = {"jax:1": "registry/jax:v1"}
+    client.create(catalog)
+    client.create(mk_nb(annotations={C.IMAGE_SELECTION_ANNOTATION: "jax:1"}))
+
+    # notebook starts running: lock removed (extension controller's job)
+    client.patch(Notebook, "user", "nb", {"metadata": {"annotations": {C.STOP_ANNOTATION: None}}})
+
+    # catalog moves the tag
+    cur = client.get(ConfigMap, "ctrl-ns", IMAGE_CATALOG_CONFIGMAP)
+    cur.data["jax:1"] = "registry/jax:v2"
+    client.update(cur)
+
+    # a user touches only metadata (labels) -> webhook re-resolves the image,
+    # but the update must NOT roll the pod
+    nb = client.get(Notebook, "user", "nb")
+    nb.metadata.labels["team"] = "ml"
+    updated = client.update(nb)
+    assert updated.spec.template.spec.containers[0].image == "registry/jax:v1"
+    pending = updated.metadata.annotations[C.UPDATE_PENDING_ANNOTATION]
+    assert "registry/jax:v1" in pending and "registry/jax:v2" in pending
+
+    # the user themselves changes the podspec -> restart allowed; the webhook
+    # still re-resolves the image from the (unchanged) selection annotation,
+    # so the new catalog target lands (reference SetContainerImageFromRegistry
+    # runs on every admission)
+    nb = client.get(Notebook, "user", "nb")
+    nb.spec.template.spec.containers[0].image = "custom/override:3"
+    updated = client.update(nb)
+    assert updated.spec.template.spec.containers[0].image == "registry/jax:v2"
+    assert C.UPDATE_PENDING_ANNOTATION not in updated.metadata.annotations
+
+    # dropping the selection annotation gives the user full image control
+    nb = client.get(Notebook, "user", "nb")
+    del nb.metadata.annotations[C.IMAGE_SELECTION_ANNOTATION]
+    nb.spec.template.spec.containers[0].image = "custom/override:3"
+    updated = client.update(nb)
+    assert updated.spec.template.spec.containers[0].image == "custom/override:3"
+
+
+def test_update_applies_when_stopped(env):
+    store, client, _ = env
+    catalog = ConfigMap()
+    catalog.metadata.name = IMAGE_CATALOG_CONFIGMAP
+    catalog.metadata.namespace = "ctrl-ns"
+    catalog.data = {"jax:1": "registry/jax:v1"}
+    client.create(catalog)
+    client.create(mk_nb(annotations={C.IMAGE_SELECTION_ANNOTATION: "jax:1"}))
+    # still locked (= stopped): catalog moves, update flows through freely
+    cur = client.get(ConfigMap, "ctrl-ns", IMAGE_CATALOG_CONFIGMAP)
+    cur.data["jax:1"] = "registry/jax:v2"
+    client.update(cur)
+    nb = client.get(Notebook, "user", "nb")
+    nb.metadata.labels["x"] = "y"
+    updated = client.update(nb)
+    assert updated.spec.template.spec.containers[0].image == "registry/jax:v2"
+    assert C.UPDATE_PENDING_ANNOTATION not in updated.metadata.annotations
+
+
+def test_proxy_env_injection():
+    store = Store()
+    client = Client(store)
+    config = Config(controller_namespace="ctrl-ns", inject_cluster_proxy_env=True)
+    NotebookWebhook(client, config).register(store)
+    cm = ConfigMap()
+    cm.metadata.name = "cluster-proxy-config"
+    cm.metadata.namespace = "ctrl-ns"
+    cm.data = {"httpProxy": "http://proxy:3128", "noProxy": ".cluster.local"}
+    client.create(cm)
+    created = client.create(mk_nb())
+    env_d = created.spec.template.spec.containers[0].env_dict()
+    assert env_d["HTTP_PROXY"] == "http://proxy:3128"
+    assert env_d["no_proxy"] == ".cluster.local"
+    assert "HTTPS_PROXY" not in env_d
