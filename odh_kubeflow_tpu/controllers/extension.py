@@ -255,6 +255,14 @@ class TPUWorkbenchReconciler:
             if pem and "BEGIN CERTIFICATE" in pem:
                 parts.append(pem.strip())
         if not parts:
+            # all CA sources gone: prune the stale bundle (reference
+            # UnsetNotebookCertConfig :639-704 analog), don't freeze it
+            try:
+                self.client.delete(
+                    ConfigMap, nb.metadata.namespace, CA_BUNDLE_CONFIGMAP
+                )
+            except NotFoundError:
+                pass
             return
         desired_data = {"ca-bundle.crt": "\n".join(parts) + "\n"}
         try:
@@ -282,7 +290,11 @@ class TPUWorkbenchReconciler:
             )
         )
         # the Gateway dataplane forwards user traffic from its own namespace —
-        # without this peer the HTTPRoute path is dead for non-auth notebooks
+        # without this peer the HTTPRoute path is dead for non-auth notebooks.
+        # In auth mode the gateway must ONLY reach the kube-rbac-proxy (:8443);
+        # admitting it to :8888 would let any route attached to the shared
+        # Gateway bypass the SubjectAccessReview.
+        auth = nb.metadata.annotations.get(C.INJECT_AUTH_ANNOTATION) == "true"
         gateway_ns_peer = NetworkPolicyPeer(
             namespace_selector=LabelSelector(
                 match_labels={"kubernetes.io/metadata.name": self.config.gateway_namespace}
@@ -298,7 +310,7 @@ class TPUWorkbenchReconciler:
         ctrl.spec.ingress = [
             NetworkPolicyIngressRule(
                 ports=[NetworkPolicyPort(protocol="TCP", port=C.NOTEBOOK_PORT)],
-                from_=[ctrl_ns_peer, gateway_ns_peer],
+                from_=[ctrl_ns_peer] if auth else [ctrl_ns_peer, gateway_ns_peer],
             ),
             NetworkPolicyIngressRule(
                 ports=[NetworkPolicyPort(protocol="TCP", port=self.config.probe_port)],
@@ -356,6 +368,14 @@ class TPUWorkbenchReconciler:
                     continue
                 data[key] = json.dumps(meta, sort_keys=True)
         if not data:
+            # last runtime-image source removed: prune the per-ns catalog so
+            # notebooks stop offering images that no longer exist
+            try:
+                self.client.delete(
+                    ConfigMap, nb.metadata.namespace, RUNTIME_IMAGES_CONFIGMAP
+                )
+            except NotFoundError:
+                pass
             return
         try:
             cur = self.client.get(

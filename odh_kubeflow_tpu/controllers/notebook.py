@@ -338,9 +338,9 @@ class NotebookReconciler:
             if primary is not None:
                 status.container_state = primary.state
 
+        newly_ready = False
         if shape is not None:
             status.tpu = status.tpu or TPUStatus()
-            was_mesh_ready = status.tpu.mesh_ready
             status.tpu.accelerator = shape.accelerator
             status.tpu.topology = shape.topology
             status.tpu.hosts = shape.hosts
@@ -356,11 +356,7 @@ class NotebookReconciler:
                 # the north-star metric: CR creation -> FIRST slice readiness
                 # (cull/restart cycles must not re-observe days-long values)
                 status.tpu.first_ready_time = now_rfc3339()
-                try:
-                    created = parse_time(nb.metadata.creation_timestamp).timestamp()
-                    self.metrics.slice_ready_seconds.observe(time.time() - created)
-                except (ValueError, TypeError):
-                    pass
+                newly_ready = True
 
         def write():
             cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
@@ -370,6 +366,14 @@ class NotebookReconciler:
             return self.client.update_status(cur)
 
         retry_on_conflict(write)
+        if newly_ready:
+            # observe only after first_ready_time persisted — a failed write
+            # retries the whole reconcile and would double-count the histogram
+            try:
+                created = parse_time(nb.metadata.creation_timestamp).timestamp()
+                self.metrics.slice_ready_seconds.observe(time.time() - created)
+            except (ValueError, TypeError):
+                pass
 
     def _handle_restart(self, nb: Notebook) -> None:
         """notebooks.opendatahub.io/notebook-restart handling (reference

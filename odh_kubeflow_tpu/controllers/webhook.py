@@ -304,7 +304,11 @@ class NotebookWebhook:
             if container.name == AUTH_PROXY_CONTAINER:
                 continue
             for name, value in mapping.items():
-                if value and not container.get_env(name):
+                # user wins if EITHER case is set: set_env matches the
+                # existing var, so writing one case would clobber the other
+                if value and not container.get_env(name) and not container.get_env(
+                    name.lower()
+                ):
                     container.set_env(name, value)
                     container.set_env(name.lower(), value)
 

@@ -287,3 +287,60 @@ def _not_found(fn):
         return False
     except NotFoundError:
         return True
+
+
+def test_auth_mode_gateway_cannot_reach_notebook_port(env):
+    """Auth notebooks: the gateway namespace may only reach :8443 — admitting
+    it to :8888 would let any route on the shared Gateway bypass the
+    SubjectAccessReview."""
+    cluster, mgr, config = env
+    from odh_kubeflow_tpu.api.networking import NetworkPolicy
+    from odh_kubeflow_tpu.controllers.constants import NOTEBOOK_PORT
+
+    cluster.client.create(
+        mk_nb("authed", annotations={C.INJECT_AUTH_ANNOTATION: "true"})
+    )
+    np = wait_for(
+        lambda: cluster.client.get(NetworkPolicy, "user", "authed-ctrl-np"),
+        msg="ctrl network policy",
+    )
+    nb_rule = next(
+        r for r in np.spec.ingress if r.ports[0].port == NOTEBOOK_PORT
+    )
+    peers = [
+        p.namespace_selector.match_labels.get("kubernetes.io/metadata.name")
+        for p in nb_rule.from_
+        if p.namespace_selector
+    ]
+    assert config.gateway_namespace not in peers
+    assert CTRL_NS in peers
+
+
+def test_runtime_images_pruned_when_sources_removed(env):
+    """Removing the last runtime-image source must prune the per-ns catalog."""
+    cluster, mgr, config = env
+    src = ConfigMap()
+    src.metadata.name = "runtime-jax"
+    src.metadata.namespace = CTRL_NS
+    src.metadata.labels = {C.RUNTIME_IMAGE_LABEL: "true"}
+    src.data = {"JAX 2026a": '{"display_name": "JAX 2026a", "image_name": "x"}'}
+    cluster.client.create(src)
+    cluster.client.create(mk_nb("rt"))
+    wait_for(
+        lambda: cluster.client.get(ConfigMap, "user", "pipeline-runtime-images"),
+        msg="runtime images synced",
+    )
+    cluster.client.delete(ConfigMap, CTRL_NS, "runtime-jax")
+    # touch the notebook to trigger a reconcile
+    cluster.client.patch(
+        Notebook, "user", "rt", {"metadata": {"annotations": {"poke": "1"}}}
+    )
+
+    def pruned():
+        try:
+            cluster.client.get(ConfigMap, "user", "pipeline-runtime-images")
+            return False
+        except NotFoundError:
+            return True
+
+    wait_for(pruned, msg="stale catalog pruned")

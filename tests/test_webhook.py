@@ -209,3 +209,23 @@ def test_proxy_env_injection():
     assert env_d["HTTP_PROXY"] == "http://proxy:3128"
     assert env_d["no_proxy"] == ".cluster.local"
     assert "HTTPS_PROXY" not in env_d
+
+
+def test_proxy_env_user_lowercase_wins():
+    """A user-set lowercase proxy var must not be clobbered by injection
+    (set_env matches exact names, so writing either case would shadow it)."""
+    store = Store()
+    client = Client(store)
+    config = Config(controller_namespace="ctrl-ns", inject_cluster_proxy_env=True)
+    NotebookWebhook(client, config).register(store)
+    cm = ConfigMap()
+    cm.metadata.name = "cluster-proxy-config"
+    cm.metadata.namespace = "ctrl-ns"
+    cm.data = {"httpProxy": "http://cluster:3128"}
+    client.create(cm)
+    nb = mk_nb()
+    nb.spec.template.spec.containers[0].set_env("http_proxy", "http://corp:8080")
+    created = client.create(nb)
+    env_d = created.spec.template.spec.containers[0].env_dict()
+    assert env_d["http_proxy"] == "http://corp:8080"
+    assert "HTTP_PROXY" not in env_d
