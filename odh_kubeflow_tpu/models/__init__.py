@@ -1,0 +1,23 @@
+"""Flagship workbench models (L8).
+
+The reference ships no model code (its payload is the user's image); the
+TPU-native build ships a reference workload so a provisioned slice can be
+exercised, benchmarked, and utilization-probed out of the box.
+"""
+from .transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_specs,
+)
+
+__all__ = [
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_train_step",
+    "param_specs",
+]

@@ -1,0 +1,221 @@
+"""Flagship decoder-only transformer, TPU-first.
+
+Design, per the north-star hardware model (not a port — the reference has no
+model code):
+
+- **MXU**: all weights/activations bf16 by default, matmuls via einsum with
+  f32 accumulation; attention is the pallas flash kernel on TPU.
+- **HBM**: layers run under `lax.scan` over stacked params (one compiled
+  layer body), with optional `jax.checkpoint` so activations rematerialize
+  in backward instead of living in HBM.
+- **Mesh**: every param carries logical axes (parallel/mesh.py RULES), so the
+  same model runs 1-chip, fsdp+tp on one slice, or +sp ring attention for
+  long context — XLA inserts the collectives.
+- **XLA semantics**: static shapes, no data-dependent Python control flow;
+  the whole train step jits once.
+
+Functional pytree style (params are plain dicts) — no framework lock-in, and
+sharding stays explicit.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import apply_rope, flash_attention, mha_reference, ring_attention, rms_norm
+from ..parallel.mesh import logical_to_spec
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    rope_theta: float = 10000.0
+    remat: bool = True
+    use_flash: bool = True
+    seq_axis: str = ""  # set to "sp" to run ring attention over that mesh axis
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# param name -> logical axes (leading "layers" axis on stacked per-layer params)
+_LAYER_AXES: Dict[str, tuple] = {
+    "attn_norm": ("layers", "norm"),
+    "wqkv": ("layers", "embed", "heads", "head_dim"),
+    "wo": ("layers", "heads", "head_dim", "embed"),
+    "mlp_norm": ("layers", "norm"),
+    "wi_gate": ("layers", "embed", "mlp"),
+    "wi_up": ("layers", "embed", "mlp"),
+    "wo_mlp": ("layers", "mlp", "embed"),
+}
+_TOP_AXES: Dict[str, tuple] = {
+    # input table's vocab dim stays unsharded: a gather over a tp-sharded
+    # vocab axis forces XLA into full rematerialization (observed on the
+    # 8-dev mesh); the unembed *matmul* shards vocab cleanly instead.
+    "embed": (None, "embed"),
+    "final_norm": ("norm",),
+    "unembed": ("embed", "vocab"),
+}
+
+
+def param_specs(cfg: TransformerConfig, mesh=None):
+    """Pytree of PartitionSpec matching init_params' structure."""
+    layers = {k: logical_to_spec(ax, mesh) for k, ax in _LAYER_AXES.items()}
+    top = {k: logical_to_spec(ax, mesh) for k, ax in _TOP_AXES.items()}
+    return {**top, "layers": layers}
+
+
+def init_params(rng, cfg: TransformerConfig):
+    """Truncated-normal init, stacked over layers for lax.scan."""
+    keys = jax.random.split(rng, 7)
+    d, h, hd, f, L = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers
+
+    def norm_init(shape):
+        return jnp.ones(shape, cfg.dtype)
+
+    def dense_init(key, shape, fan_in):
+        return (
+            jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+            * (1.0 / fan_in) ** 0.5
+        ).astype(cfg.dtype)
+
+    return {
+        "embed": dense_init(keys[0], (cfg.vocab, d), d),
+        "final_norm": norm_init((d,)),
+        "unembed": dense_init(keys[1], (d, cfg.vocab), d),
+        "layers": {
+            "attn_norm": norm_init((L, d)),
+            "wqkv": dense_init(keys[2], (L, d, 3 * h, hd), d),
+            "wo": dense_init(keys[3], (L, h, hd, d), d),
+            "mlp_norm": norm_init((L, d)),
+            "wi_gate": dense_init(keys[4], (L, d, f), d),
+            "wi_up": dense_init(keys[5], (L, d, f), d),
+            "wo_mlp": dense_init(keys[6], (L, f, d), f),
+        },
+    }
+
+
+def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
+    if cfg.seq_axis and mesh is not None:
+        # ppermute needs bound axis names: run the ring under shard_map over
+        # the FULL mesh; only `sp` collectives occur, other axes stay local.
+        spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), mesh)
+        fn = jax.shard_map(
+            partial(ring_attention, axis_name=cfg.seq_axis, causal=True),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    if cfg.use_flash:
+        return flash_attention(q, k, v, causal=True)  # falls back off-TPU
+    return mha_reference(q, k, v, causal=True)
+
+
+def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
+    """One pre-norm block. x: (batch, seq, d_model)."""
+    h, hd = cfg.n_heads, cfg.head_dim
+
+    def constrain(y, axes):
+        if mesh is None:
+            return y
+        return lax.with_sharding_constraint(
+            y, jax.sharding.NamedSharding(mesh, logical_to_spec(axes, mesh))
+        )
+
+    # attention
+    y = rms_norm(x, layer_params["attn_norm"])
+    qkv = jnp.einsum(
+        "bsd,dnh->bsnh", y, layer_params["wqkv"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=2)  # (b, s, h, hd) each
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v, cfg, mesh)
+    attn = constrain(attn, ("batch", "seq", "heads", "head_dim"))
+    x = x + jnp.einsum(
+        "bsnh,nhd->bsd", attn, layer_params["wo"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", None))  # residual replicated over tp
+
+    # mlp (SwiGLU)
+    y = rms_norm(x, layer_params["mlp_norm"])
+    gate = jnp.einsum(
+        "bsd,df->bsf", y, layer_params["wi_gate"], preferred_element_type=jnp.float32
+    )
+    up = jnp.einsum(
+        "bsd,df->bsf", y, layer_params["wi_up"], preferred_element_type=jnp.float32
+    )
+    act = (jax.nn.silu(gate) * up).astype(cfg.dtype)
+    act = constrain(act, ("batch", "seq", "mlp"))
+    x = x + jnp.einsum(
+        "bsf,fd->bsd", act, layer_params["wo_mlp"], preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    return x
+
+
+def forward(params, tokens, cfg: TransformerConfig, mesh=None, positions=None):
+    """Logits for next-token prediction. tokens: (batch, seq) int32; with
+    sp-sharding, `positions` carries each shard's global positions."""
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, tokens.shape)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    body = partial(_layer, positions=positions, cfg=cfg, mesh=mesh)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(carry, layer_params):
+        return body(carry, layer_params), None
+
+    x, _ = lax.scan(scan_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"], preferred_element_type=jnp.float32
+    )
+    return logits
+
+
+def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
+    """Causal LM cross-entropy. batch: {"tokens": (b, s), "positions"?}."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, cfg, mesh=mesh, positions=batch.get("positions"))
+    targets = batch.get("targets")
+    if targets is None:
+        logits, targets = logits[:, :-1], tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: TransformerConfig, optimizer=None, mesh=None):
+    """(params, opt_state, batch) -> (params, opt_state, loss), jittable.
+    Default optimizer: optax.adamw with f32 moments (params may be bf16)."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(
+        3e-4, b1=0.9, b2=0.95, weight_decay=0.1, mu_dtype=jnp.float32
+    )
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, optimizer

@@ -1,0 +1,20 @@
+"""TPU-first neural net ops for the workbench workload library (L8).
+
+Hot ops only: flash attention as a pallas kernel (MXU-tiled, online softmax),
+ring attention for sequence parallelism over the `sp` mesh axis, and the
+small fusible pieces (RMSNorm, RoPE) left to XLA, which fuses elementwise
+chains into the surrounding matmuls better than hand-scheduling would.
+"""
+from .attention import flash_attention, mha_reference
+from .norms import rms_norm
+from .ring_attention import ring_attention
+from .rotary import apply_rope, rope_freqs
+
+__all__ = [
+    "apply_rope",
+    "flash_attention",
+    "mha_reference",
+    "ring_attention",
+    "rms_norm",
+    "rope_freqs",
+]

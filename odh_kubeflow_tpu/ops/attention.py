@@ -1,0 +1,149 @@
+"""Flash attention as a pallas TPU kernel.
+
+The framework's hottest op: O(seq²) score matrices never materialize in HBM.
+Grid is (batch*heads, q_blocks); each program streams K/V blocks through the
+MXU with an online-softmax carry (m, l, acc) in f32, writing one (block_q,
+head_dim) output tile. Causal programs stop their K loop at the diagonal
+block, so the wasted upper-triangle work is at most one block per row.
+
+Off-TPU (CPU tests, the 8-device virtual mesh) the jnp reference path is used
+— same math, f32 accumulation — keeping unit tests hardware-independent while
+the kernel runs under `interpret=True` in kernel-specific tests.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas import is deferred-safe: CPU-only environments still get mha
+    from jax.experimental import pallas as pl
+
+    _HAVE_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+NEG_INF = -1e30
+
+
+def mha_reference(q, k, v, causal: bool = True, q_offset: int = 0, kv_offset: int = 0):
+    """Reference attention. q: (b, sq, h, d); k/v: (b, sk, h, d). Offsets give
+    the global positions of the local q/k windows (ring-attention shards)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = kv_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float):
+    block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    if causal:
+        # K blocks strictly below the diagonal need no mask; the diagonal
+        # block is masked elementwise. Loop bound is data-independent given
+        # the grid position, so XLA sees a static-shape fori_loop. Clamped to
+        # the K extent: with sq > sk the diagonal can pass the last K block.
+        num_kb = jnp.minimum(
+            lax.div((qi + 1) * block_q + block_k - 1, block_k), seq_k // block_k
+        )
+    else:
+        num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q,
+            k.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p,
+            v.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+):
+    """Fused attention. q/k/v: (batch, seq, heads, head_dim), seq divisible by
+    the block sizes. Dispatches to the pallas kernel on TPU (or interpret=True
+    anywhere); otherwise the XLA reference path."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    on_tpu = jax.default_backend() == "tpu"
+    if interpret is None:
+        interpret = False
+    use_kernel = (
+        _HAVE_PALLAS
+        and (on_tpu or interpret)
+        and sq % block_q == 0
+        and sk % block_k == 0
+    )
+    if not use_kernel:
+        return mha_reference(q, k, v, causal=causal)
+
+    # (b, s, h, d) -> (b*h, s, d): one grid row per (batch, head)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, sm_scale=d**-0.5
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -1,0 +1,18 @@
+"""Normalization ops.
+
+Deliberately plain jnp: RMSNorm is a short elementwise+reduce chain that XLA
+fuses into the adjacent matmul's epilogue/prologue on TPU; a hand-written
+kernel here would only block that fusion. Accumulation in f32 regardless of
+activation dtype (bf16-safe).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x, scale, eps: float = 1e-6):
+    """RMSNorm over the last axis; returns x's dtype, computes in f32."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,31 @@
+"""Rotary position embeddings (RoPE).
+
+Plain jnp (XLA fuses this into the QK projection epilogue). Takes explicit
+absolute positions so sequence-parallel shards (ring attention) apply the
+correct global phase to their local slice.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_freqs(head_dim: int, theta: float = 10000.0):
+    """Inverse frequencies, shape (head_dim // 2,), f32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotate x (..., seq, heads, head_dim) by absolute `positions` (..., seq).
+
+    Pairs (x[2i], x[2i+1]) are rotated by positions * freq_i; computed in f32,
+    returned in x's dtype.
+    """
+    freqs = rope_freqs(x.shape[-1], theta)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # (..., seq, d/2)
+    angles = angles[..., None, :]  # broadcast over heads
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    out = jnp.stack((x1 * cos - x2 * sin, x1 * sin + x2 * cos), axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)

@@ -1,0 +1,29 @@
+"""Workbench parallelism library (L8).
+
+The reference operator has no workload library at all (SURVEY §2.4: DP/TP/PP/
+SP absent — the payload is whatever image the user picks). The TPU-native
+build ships one into the notebook images it provisions, so that the env the
+webhook injects (tpu/env.py) turns into a live ICI mesh with one call:
+
+    from odh_kubeflow_tpu.parallel import initialize_from_env, MeshPlan
+    initialize_from_env()                       # multi-host bring-up
+    mesh = MeshPlan.auto(len(jax.devices())).build()
+"""
+from .distributed import initialize_from_env, slice_mesh_axes
+from .mesh import (
+    AXES,
+    MeshPlan,
+    batch_spec,
+    logical_to_spec,
+    shard_batch,
+)
+
+__all__ = [
+    "AXES",
+    "MeshPlan",
+    "batch_spec",
+    "initialize_from_env",
+    "logical_to_spec",
+    "shard_batch",
+    "slice_mesh_axes",
+]

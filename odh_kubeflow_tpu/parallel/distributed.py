@@ -1,0 +1,65 @@
+"""Multi-host bring-up: consume the env the webhook injected.
+
+The reference's distributed backend is the kube-apiserver watch protocol
+(SURVEY §2.4); the workload side has none. Here the controller's webhook
+injects JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID /
+TPU_WORKER_ID (tpu/env.py — coordinator = ordinal-0 pod's headless-Service
+DNS), and this module turns them into a live `jax.distributed` mesh. The ICI
+collectives then come from XLA (psum/all-gather/ppermute over the Mesh), not
+from an NCCL/MPI port.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from ..tpu.env import COORDINATOR_PORT
+from ..tpu.topology import SliceShape
+
+
+def initialize_from_env(timeout_s: Optional[int] = None) -> Tuple[int, int]:
+    """Initialize jax.distributed from webhook-injected env; no-op on single
+    host. Returns (process_id, num_processes). Idempotent."""
+    num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1)
+    if num_processes <= 1:
+        return 0, 1
+    process_id = int(
+        os.environ.get("JAX_PROCESS_ID", os.environ.get("TPU_WORKER_ID", "0")) or 0
+    )
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    if not coordinator:
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+        if not hosts or not hosts[0]:
+            raise RuntimeError(
+                "multi-host slice but neither JAX_COORDINATOR_ADDRESS nor "
+                "TPU_WORKER_HOSTNAMES set (webhook env injection missing?)"
+            )
+        coordinator = f"{hosts[0]}:{COORDINATOR_PORT}"
+
+    import jax
+
+    if jax.process_count() == num_processes:  # already initialized
+        return jax.process_index(), jax.process_count()
+    kwargs = {}
+    if timeout_s is not None:
+        kwargs["initialization_timeout"] = timeout_s
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+    return process_id, num_processes
+
+
+def slice_mesh_axes(shape: SliceShape, want_sp: int = 1, want_tp: int = 0):
+    """MeshPlan for a whole slice: tp defaults to the chips of one host (tp
+    collectives stay on-board), sp as requested for long-context, fsdp gets
+    the rest — the scaling-book default for a single ICI domain."""
+    from .mesh import MeshPlan
+
+    return MeshPlan.auto(
+        shape.chips,
+        want_sp=want_sp,
+        want_tp=want_tp or shape.chips_per_host,
+    )

@@ -1,0 +1,150 @@
+"""Device-mesh planning and logical-axis sharding rules.
+
+The scaling-book recipe: pick a mesh, annotate shardings with logical axis
+names, let XLA insert the collectives. Axes:
+
+- ``dp``    pure data parallelism (params replicated) — rides DCN between
+            slices if present,
+- ``fsdp``  data parallelism with parameters sharded (ZeRO-3 style; XLA
+            all-gathers weights per layer, reduce-scatters grads) — rides ICI,
+- ``tp``    tensor parallelism over heads / mlp-hidden — innermost, most
+            bandwidth-hungry, so closest ICI neighbors,
+- ``sp``    sequence/context parallelism for long contexts (ring attention,
+            ops/ring_attention.py).
+
+Parameters and activations carry *logical* axis names ("vocab", "embed",
+"heads", "mlp", "batch", "seq"); `logical_to_spec` maps them onto mesh axes
+through RULES so a model written once runs under any MeshPlan.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+AXES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp")
+
+# logical axis -> mesh axis (or tuple of mesh axes). None = replicated.
+RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",  # param sharding axis for ZeRO-3-style fsdp
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "head_dim": None,
+    "layers": None,
+    "norm": None,
+}
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """Axis sizes for a jax.sharding.Mesh over the slice's devices."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+
+    @staticmethod
+    def auto(
+        n_devices: int,
+        want_sp: int = 1,
+        want_tp: int = 1,
+        prefer_fsdp: bool = True,
+    ) -> "MeshPlan":
+        """Factor n_devices into mesh axes. sp/tp are capped at what divides;
+        the remainder goes to fsdp (or dp if prefer_fsdp=False).
+
+        Deterministic and total: any n >= 1 yields a valid plan.
+        """
+
+        def largest_divisor_leq(n: int, cap: int) -> int:
+            d = 1
+            for c in range(1, min(n, cap) + 1):
+                if n % c == 0:
+                    d = c
+            return d
+
+        rest = n_devices
+        sp = largest_divisor_leq(rest, want_sp)
+        rest //= sp
+        tp = largest_divisor_leq(rest, want_tp)
+        rest //= tp
+        if prefer_fsdp:
+            return MeshPlan(dp=1, fsdp=rest, tp=tp, sp=sp)
+        return MeshPlan(dp=rest, fsdp=1, tp=tp, sp=sp)
+
+    def build(self, devices: Optional[Sequence] = None):
+        """Build the jax.sharding.Mesh. Axis order is (dp, fsdp, tp, sp) with
+        tp/sp innermost so their collectives ride nearest-neighbor ICI."""
+        import jax
+
+        devices = list(devices if devices is not None else jax.devices())
+        if len(devices) != self.n_devices:
+            raise ValueError(
+                f"MeshPlan{self.sizes()} needs {self.n_devices} devices, "
+                f"got {len(devices)}"
+            )
+        grid = np.array(devices).reshape(self.dp, self.fsdp, self.tp, self.sp)
+        return jax.sharding.Mesh(grid, AXES)
+
+
+def logical_to_spec(logical_axes: Sequence[Optional[str]], mesh=None):
+    """Translate ("batch","seq","embed")-style logical axes to a PartitionSpec
+    via RULES, dropping mesh axes of size 1 (so specs stay valid on any mesh
+    and XLA sees no trivial shardings)."""
+    from jax.sharding import PartitionSpec
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh is not None else None
+
+    def live(axis: Union[str, Tuple[str, ...], None]):
+        if axis is None:
+            return None
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        if sizes is not None:
+            axes = tuple(a for a in axes if sizes.get(a, 1) > 1)
+        if not axes:
+            return None
+        return axes[0] if len(axes) == 1 else axes
+
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+            continue
+        if name not in RULES:
+            raise KeyError(f"unknown logical axis {name!r}; known: {sorted(RULES)}")
+        out.append(live(RULES[name]))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def batch_spec(mesh=None, with_seq: bool = True):
+    """PartitionSpec for a (batch, seq) token array."""
+    return logical_to_spec(("batch", "seq") if with_seq else ("batch",), mesh)
+
+
+def shard_batch(mesh, arrays):
+    """Device_put a pytree of (batch, seq, ...) host arrays onto the mesh."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def put(x):
+        axes = ["batch", "seq"] + [None] * (x.ndim - 2)
+        return jax.device_put(
+            x, NamedSharding(mesh, logical_to_spec(axes[: x.ndim], mesh))
+        )
+
+    return jax.tree_util.tree_map(put, arrays)

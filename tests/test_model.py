@@ -1,0 +1,99 @@
+"""Flagship transformer: correctness single-device, parity under full
+fsdp/tp/sp sharding, and the driver entry contract."""
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding
+
+from odh_kubeflow_tpu.models import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_specs,
+)
+from odh_kubeflow_tpu.parallel import MeshPlan, shard_batch
+
+
+def tiny(dtype=jnp.float32, **kw):
+    return TransformerConfig(
+        vocab=64,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        d_ff=128,
+        max_seq=64,
+        dtype=dtype,
+        use_flash=False,
+        **kw,
+    )
+
+
+def data(batch=4, seq=32):
+    return {
+        "tokens": jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, 64)
+    }
+
+
+def test_forward_shapes_and_f32_logits():
+    cfg = tiny(dtype=jnp.bfloat16)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    logits = jax.jit(lambda p, t: forward(p, t, cfg))(params, data()["tokens"])
+    assert logits.shape == (4, 32, 64)
+    assert logits.dtype == jnp.float32  # loss math never in bf16
+
+
+def test_loss_decreases():
+    cfg = tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    step, opt = make_train_step(cfg)
+    opt_state = opt.init(params)
+    batch = data()
+    jstep = jax.jit(step)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = jstep(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_sharded_matches_single_device():
+    """Same params/batch: loss on the fsdp=2,tp=2,sp=2 mesh (ring attention
+    on) must match the unsharded loss — collectives change layout, not math."""
+    cfg1 = tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg1)
+    batch = data(batch=4, seq=32)
+    base = float(jax.jit(lambda p, b: loss_fn(p, b, cfg1))(params, batch))
+
+    mesh = MeshPlan(fsdp=2, tp=2, sp=2).build()
+    cfg = tiny(seq_axis="sp")
+    specs = param_specs(cfg, mesh)
+    sharded = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
+    )
+    sbatch = shard_batch(mesh, batch)
+    got = float(
+        jax.jit(lambda p, b: loss_fn(p, b, cfg, mesh=mesh))(sharded, sbatch)
+    )
+    assert got == pytest.approx(base, rel=1e-4)
+
+
+def test_param_specs_match_param_tree():
+    cfg = tiny()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    specs = param_specs(cfg)
+    assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(
+        specs
+    )
+    for p, s in zip(jax.tree_util.tree_leaves(params), jax.tree_util.tree_leaves(specs)):
+        assert len(s) <= p.ndim
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.ndim == 3
+    ge.dryrun_multichip(8)

@@ -1,0 +1,78 @@
+"""L8 parallel: mesh planning, logical-axis sharding rules, env bring-up."""
+import os
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec
+
+from odh_kubeflow_tpu.parallel import (
+    MeshPlan,
+    initialize_from_env,
+    shard_batch,
+    slice_mesh_axes,
+)
+from odh_kubeflow_tpu.parallel.mesh import logical_to_spec
+from odh_kubeflow_tpu.tpu import plan_slice
+
+
+def test_auto_plan_factors_exactly():
+    for n in (1, 2, 4, 8, 16, 32):
+        plan = MeshPlan.auto(n, want_sp=2, want_tp=2)
+        assert plan.n_devices == n
+    # non-dividing wants are capped, never crash
+    assert MeshPlan.auto(6, want_sp=4, want_tp=4).n_devices == 6
+    assert MeshPlan.auto(1, want_sp=8, want_tp=8) == MeshPlan()
+
+
+def test_mesh_build_and_axis_order():
+    mesh = MeshPlan(fsdp=2, tp=2, sp=2).build()
+    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp")
+    assert mesh.devices.shape == (1, 2, 2, 2)
+    with pytest.raises(ValueError):
+        MeshPlan(fsdp=4).build(jax.devices()[:3])
+
+
+def test_logical_to_spec_drops_dead_axes():
+    mesh = MeshPlan(fsdp=2, tp=2, sp=2).build()
+    assert logical_to_spec(("batch", "seq"), mesh) == PartitionSpec("fsdp", "sp")
+    assert logical_to_spec(("embed", "heads", "head_dim"), mesh) == PartitionSpec(
+        "fsdp", "tp"
+    )
+    # all-dp mesh of size 1 on those axes -> fully replicated
+    mesh1 = MeshPlan(dp=8).build()
+    assert logical_to_spec(("embed", "heads"), mesh1) == PartitionSpec()
+    assert logical_to_spec(("batch", "seq"), mesh1) == PartitionSpec("dp")
+    with pytest.raises(KeyError):
+        logical_to_spec(("nonsense",), mesh)
+
+
+def test_shard_batch_places_on_mesh():
+    import jax.numpy as jnp
+
+    mesh = MeshPlan(fsdp=4, sp=2).build()
+    batch = shard_batch(mesh, {"tokens": jnp.ones((8, 16), jnp.int32)})
+    sharding = batch["tokens"].sharding
+    assert sharding.spec == PartitionSpec("fsdp", "sp")
+
+
+def test_initialize_from_env_single_host_noop(monkeypatch):
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert initialize_from_env() == (0, 1)
+
+
+def test_initialize_from_env_missing_coordinator(monkeypatch):
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "")
+    with pytest.raises(RuntimeError, match="webhook env injection"):
+        initialize_from_env()
+
+
+def test_slice_mesh_axes_defaults_tp_to_host_chips():
+    shape = plan_slice("v5p", topology="2x2x4")  # 16 chips, 4 hosts x 4
+    plan = slice_mesh_axes(shape)
+    assert plan.n_devices == 16
+    assert plan.tp == 4  # tp collectives stay on one host's chips
+    long_ctx = slice_mesh_axes(shape, want_sp=4)
+    assert long_ctx.sp == 4 and long_ctx.n_devices == 16
