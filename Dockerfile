@@ -1,0 +1,18 @@
+# Controller-manager image (the reference builds with ubi9/go-toolset from the
+# components/ context — notebook-controller/Dockerfile:1-30; this build is a
+# Python manager plus an optional C++ runtime core compiled at image build).
+FROM python:3.11-slim AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY pyproject.toml ./
+COPY odh_kubeflow_tpu ./odh_kubeflow_tpu
+COPY native ./native
+RUN make -C native 2>/dev/null || true
+RUN pip install --no-cache-dir .
+
+FROM python:3.11-slim
+RUN useradd --uid 1001 --create-home controller
+COPY --from=builder /usr/local/lib/python3.11/site-packages /usr/local/lib/python3.11/site-packages
+USER 1001
+ENTRYPOINT ["python", "-m", "odh_kubeflow_tpu.main"]

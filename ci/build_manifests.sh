@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Build-validate every overlay (the reference's ci/kustomize.sh: kustomize
+# build each config tree and fail on error).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+for overlay in base standalone gke dev; do
+  echo "--- building overlay: ${overlay}"
+  python -m odh_kubeflow_tpu.deploy build "${overlay}" --params deploy/params.env >/dev/null
+done
+echo "all overlays build"

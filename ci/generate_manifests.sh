@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Manifest-drift gate (the reference's ci/generate_code.sh: regenerate with
+# controller-gen and fail on git diff; here the generator is
+# odh_kubeflow_tpu.deploy and the tree is deploy/).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m odh_kubeflow_tpu.deploy generate --root deploy
+
+if ! git diff --quiet -- deploy/; then
+  echo "ERROR: deploy/ manifests drifted from the generators." >&2
+  echo "Run: python -m odh_kubeflow_tpu.deploy generate" >&2
+  git --no-pager diff --stat -- deploy/ >&2
+  exit 1
+fi
+echo "deploy/ manifests up to date"

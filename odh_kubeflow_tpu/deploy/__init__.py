@@ -1,0 +1,18 @@
+"""Deploy/config layer: CRD generation, base manifests, overlays (SURVEY §2.3).
+
+The kustomize-equivalent for the TPU build: `crdgen` plays controller-gen,
+`manifests` is config/{crd,rbac,manager,webhook,default}, `overlay` is the
+params.env + overlays mechanism. CLI: ``python -m odh_kubeflow_tpu.deploy``.
+"""
+from .crdgen import notebook_crd, schema_for_model
+from .overlay import OVERLAYS, build, load_params, merge_patch, render_yaml
+
+__all__ = [
+    "notebook_crd",
+    "schema_for_model",
+    "OVERLAYS",
+    "build",
+    "load_params",
+    "merge_patch",
+    "render_yaml",
+]

@@ -1,0 +1,151 @@
+"""Deploy layer: CRD generation, overlays, params, drift (SURVEY §2.3)."""
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from odh_kubeflow_tpu.deploy import (
+    OVERLAYS,
+    build,
+    load_params,
+    merge_patch,
+    notebook_crd,
+    render_yaml,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _by_kind(manifests, kind):
+    return [m for m in manifests if m["kind"] == kind]
+
+
+def test_crd_serves_all_versions_with_hub_storage():
+    crd = notebook_crd()
+    versions = {v["name"]: v for v in crd["spec"]["versions"]}
+    assert set(versions) == {"v1beta1", "v1", "v1alpha1"}
+    assert versions["v1beta1"]["storage"] is True
+    assert not versions["v1"]["storage"] and not versions["v1alpha1"]["storage"]
+
+
+def test_crd_schema_has_tpu_block_and_podspec():
+    crd = notebook_crd()
+    schema = crd["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+    spec = schema["properties"]["spec"]["properties"]
+    tpu = spec["tpu"]["properties"]
+    assert tpu["accelerator"] == {"type": "string"}
+    assert tpu["chips"] == {"type": "integer"}
+    pod = spec["template"]["properties"]["spec"]
+    assert "containers" in pod["properties"]
+    assert pod["x-kubernetes-preserve-unknown-fields"] is True
+    status = schema["properties"]["status"]["properties"]
+    assert status["tpu"]["properties"]["chipsVisible"] == {"type": "integer"}
+
+
+def test_base_build_is_complete_and_yaml_round_trips():
+    manifests = build("base")
+    kinds = sorted(m["kind"] for m in manifests)
+    for expected in [
+        "CustomResourceDefinition",
+        "ClusterRole",
+        "ClusterRoleBinding",
+        "ConfigMap",
+        "Deployment",
+        "MutatingWebhookConfiguration",
+        "Namespace",
+        "Service",
+        "ServiceAccount",
+    ]:
+        assert expected in kinds, f"missing {expected}"
+    docs = list(yaml.safe_load_all(render_yaml(manifests)))
+    assert docs == manifests
+
+
+def test_params_pin_images():
+    params = {"odh-notebook-controller-image": "example.com/ctrl:v9",
+              "namespace": "custom-ns"}
+    manifests = build("base", params)
+    dep = _by_kind(manifests, "Deployment")[0]
+    assert dep["metadata"]["namespace"] == "custom-ns"
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "example.com/ctrl:v9"
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["K8S_NAMESPACE"] == "custom-ns"
+
+
+def test_webhook_fail_policy_and_service_wiring():
+    manifests = build("base")
+    wh = _by_kind(manifests, "MutatingWebhookConfiguration")[0]["webhooks"][0]
+    assert wh["failurePolicy"] == "Fail"
+    svc_ref = wh["clientConfig"]["service"]
+    names = {m["metadata"]["name"] for m in _by_kind(manifests, "Service")}
+    assert svc_ref["name"] in names
+    assert {"v1beta1", "v1", "v1alpha1"} == set(wh["rules"][0]["apiVersions"])
+
+
+def test_standalone_overlay_enables_culling_with_ci_cadence():
+    cm = _by_kind(build("standalone"), "ConfigMap")[0]
+    assert cm["data"]["ENABLE_CULLING"] == "true"
+    assert cm["data"]["CULL_IDLE_TIME"] == "60"
+    assert cm["data"]["IDLENESS_CHECK_PERIOD"] == "5"
+
+
+def test_gke_overlay_adds_gateway_and_certmanager():
+    manifests = build("gke")
+    gws = _by_kind(manifests, "Gateway")
+    assert gws and gws[0]["spec"]["gatewayClassName"].startswith("gke-l7")
+    wh = _by_kind(manifests, "MutatingWebhookConfiguration")[0]
+    assert "cert-manager.io/inject-ca-from" in wh["metadata"]["annotations"]
+
+
+def test_load_params_parses_and_rejects_garbage():
+    p = load_params("# comment\nfoo=bar\n\nbaz = qux \n")
+    assert p == {"foo": "bar", "baz": "qux"}
+    with pytest.raises(ValueError):
+        load_params("not-a-param")
+
+
+def test_merge_patch_rfc7386_semantics():
+    assert merge_patch({"a": {"b": 1, "c": 2}}, {"a": {"b": None, "d": 3}}) == {
+        "a": {"c": 2, "d": 3}
+    }
+    assert merge_patch({"a": [1, 2]}, {"a": [3]}) == {"a": [3]}
+
+
+def test_unmatched_overlay_patch_fails_build():
+    from odh_kubeflow_tpu.deploy.overlay import apply_patches
+
+    with pytest.raises(ValueError, match="matched no manifest"):
+        apply_patches([], [{"kind": "ConfigMap", "metadata": {"name": "x"}}])
+
+
+def test_committed_deploy_tree_is_not_drifted(tmp_path):
+    """ci/generate_manifests.sh analog: regenerating must match deploy/."""
+    from odh_kubeflow_tpu.deploy.__main__ import generate_tree
+
+    committed = os.path.join(REPO, "deploy")
+    if not os.path.exists(os.path.join(committed, "base", "manifests.yaml")):
+        pytest.skip("deploy tree not generated yet")
+    generate_tree(str(tmp_path), os.path.join(committed, "params.env"))
+    for rel in ["base/manifests.yaml"] + [
+        f"overlays/{n}/manifests.yaml" for n in sorted(OVERLAYS) if n != "base"
+    ]:
+        with open(os.path.join(committed, rel)) as f:
+            want = f.read()
+        with open(os.path.join(tmp_path, rel)) as f:
+            got = f.read()
+        assert got == want, f"deploy/{rel} drifted — run python -m odh_kubeflow_tpu.deploy generate"
+
+
+def test_cli_build_prints_yaml():
+    out = subprocess.run(
+        [sys.executable, "-m", "odh_kubeflow_tpu.deploy", "build", "standalone"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        check=True,
+    ).stdout
+    docs = list(yaml.safe_load_all(out))
+    assert any(d["kind"] == "CustomResourceDefinition" for d in docs)
