@@ -1,10 +1,11 @@
 """Flash attention as a pallas TPU kernel.
 
 The framework's hottest op: O(seq²) score matrices never materialize in HBM.
-Grid is (batch*heads, q_blocks); each program streams K/V blocks through the
-MXU with an online-softmax carry (m, l, acc) in f32, writing one (block_q,
-head_dim) output tile. Causal programs stop their K loop at the diagonal
-block, so the wasted upper-triangle work is at most one block per row.
+Grid is (batch*heads, q_blocks, k_blocks); K/V stream through VMEM one
+(block_k, head_dim) tile per step while the online-softmax carry (m, l, acc)
+rides VMEM scratch across the innermost k dimension, so usable sequence
+length is bounded by HBM, not VMEM. Causal grid steps above the diagonal
+skip their compute (the diagonal block masks elementwise).
 
 Off-TPU (CPU tests, the 8-device virtual mesh) the jnp reference path is used
 — same math, f32 accumulation — keeping unit tests hardware-independent while
@@ -13,7 +14,6 @@ the kernel runs under `interpret=True` in kernel-specific tests.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,59 +42,68 @@ def mha_reference(q, k, v, causal: bool = True, q_offset: int = 0, kv_offset: in
         mask = qpos[:, None] >= kpos[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float):
-    block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
-    seq_k = k_ref.shape[1]
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float, num_kb: int,
+):
+    """Grid (batch*heads, q_blocks, k_blocks); K/V stream one (block_k, d)
+    tile per step while the online-softmax carry (m, l, acc) lives in VMEM
+    scratch across the innermost (k) grid dimension. m/l are stored
+    lane-broadcast (block_q, 128) so the scratch keeps TPU-native tiling."""
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
-
-    if causal:
-        # K blocks strictly below the diagonal need no mask; the diagonal
-        # block is masked elementwise. Loop bound is data-independent given
-        # the grid position, so XLA sees a static-shape fori_loop. Clamped to
-        # the K extent: with sq > sk the diagonal can pass the last K block.
-        num_kb = jnp.minimum(
-            lax.div((qi + 1) * block_q + block_k - 1, block_k), seq_k // block_k
-        )
-    else:
-        num_kb = seq_k // block_k
-
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+    def _fold():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
         s = jax.lax.dot_general(
             q,
-            k.astype(jnp.float32),
+            k_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (block_q, block_k)
         if causal:
             qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = kb * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m - m_new)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p,
-            v.astype(jnp.float32),
+            v_ref[0].astype(jnp.float32),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    m, l, acc = lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if causal:
+        # K blocks entirely above the diagonal fold nothing; their compute
+        # (not their DMA) is skipped. The diagonal block masks elementwise.
+        pl.when(ki * block_k < (qi + 1) * block_q)(_fold)
+    else:
+        _fold()
+
+    @pl.when(ki == num_kb - 1)
+    def _emit():
+        o_ref[0] = (
+            acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -107,7 +116,7 @@ def flash_attention(
     causal: bool = True,
     block_q: int = 128,
     block_k: int = 128,
-    interpret: Optional[bool] = None,
+    interpret: bool = False,
 ):
     """Fused attention. q/k/v: (batch, seq, heads, head_dim), seq divisible by
     the block sizes. Dispatches to the pallas kernel on TPU (or interpret=True
@@ -115,8 +124,6 @@ def flash_attention(
     b, sq, h, d = q.shape
     sk = k.shape[1]
     on_tpu = jax.default_backend() == "tpu"
-    if interpret is None:
-        interpret = False
     use_kernel = (
         _HAVE_PALLAS
         and (on_tpu or interpret)
@@ -131,19 +138,41 @@ def flash_attention(
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
 
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_kb = sk // block_k
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, sm_scale=d**-0.5
+        _flash_kernel,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        sm_scale=d**-0.5,
+        num_kb=num_kb,
     )
+    try:
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    except (AttributeError, TypeError):  # pragma: no cover - older pallas API
+        compiler_params = None
     out = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q),
+        grid=(b * h, sq // block_q, num_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            # q's index map ignores ki -> pallas keeps the block resident
+            # across the whole K stream (no re-DMA)
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-broadcast)
+            pltpu.VMEM((block_q, 128), jnp.float32),  # l (lane-broadcast)
+            pltpu.VMEM((block_q, d), jnp.float32),  # acc
+        ],
+        compiler_params=compiler_params,
         interpret=interpret,
     )(qt, kt, vt)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -48,8 +48,7 @@ def _ring_body(q, k, v, axis_name: str, causal: bool):
     acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def step(i, carry):
-        m, l, acc, k_cur, v_cur = carry
+    def fold(i, m, l, acc, k_cur, v_cur):
         # k_cur started life on shard (my_idx - i) mod axis_size
         src = (my_idx - i) % axis_size
         bm, bl, bacc = _local_block(
@@ -57,13 +56,22 @@ def _ring_body(q, k, v, axis_name: str, causal: bool):
         )
         m_new = jnp.maximum(m, bm)
         alpha, balpha = jnp.exp(m - m_new), jnp.exp(bm - m_new)
-        l = l * alpha + bl * balpha
-        acc = acc * alpha + bacc * balpha
+        return m_new, l * alpha + bl * balpha, acc * alpha + bacc * balpha
+
+    def step(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = fold(i, m, l, acc, k_cur, v_cur)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return m_new, l, acc, k_nxt, v_nxt
+        return m, l, acc, k_nxt, v_nxt
 
-    m, l, acc, _, _ = lax.fori_loop(0, axis_size, step, (m0, l0, acc0, k, v))
+    # The last visiting block is folded OUTSIDE the loop: its K/V never move
+    # again, so the ring does axis_size-1 transfers, not axis_size.
+    carry = (m0, l0, acc0, k, v)
+    if axis_size > 1:
+        carry = lax.fori_loop(0, axis_size - 1, step, carry)
+    m, l, acc, k_last, v_last = carry
+    m, l, acc = fold(axis_size - 1, m, l, acc, k_last, v_last)
     out = acc / jnp.maximum(l, 1e-30)  # (b, h, sq, d)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

@@ -38,7 +38,10 @@ def initialize_from_env(timeout_s: Optional[int] = None) -> Tuple[int, int]:
 
     import jax
 
-    if jax.process_count() == num_processes:  # already initialized
+    # Idempotence must be checked WITHOUT touching the backend:
+    # jax.process_count() initializes XLA, after which
+    # jax.distributed.initialize() always raises.
+    if jax.distributed.is_initialized():
         return jax.process_index(), jax.process_count()
     kwargs = {}
     if timeout_s is not None:
