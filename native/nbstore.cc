@@ -1,0 +1,199 @@
+// nbstore: the native storage core of the in-process control plane.
+//
+// The reference's control plane is a compiled Go binary on top of etcd
+// (kube-apiserver); this library is the equivalent storage engine for the
+// TPU build's in-process cluster: canonical-JSON object buckets with a
+// monotonically increasing resourceVersion counter and snapshot-isolated
+// reads (every get returns an independent buffer, so Python-side mutation
+// can never corrupt stored state). Admission, finalizer semantics, GC and
+// watch fan-out stay in Python (cluster/store.py); this owns the bytes.
+//
+// C ABI only — consumed via ctypes (no pybind11 in the image).
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+struct Entry {
+  std::string json;
+  std::string ns;      // metadata.namespace, extracted by the Python binding
+  std::string labels;  // "k\x1Fv\x1Fk2\x1Fv2" pairs, unit-separated
+};
+
+struct Bucket {
+  // std::map keeps keys ordered, so list() is deterministic without a sort.
+  std::map<std::string, Entry> objs;
+};
+
+struct Handle {
+  std::mutex mu;
+  uint64_t rv = 0;
+  std::unordered_map<std::string, Bucket> buckets;
+};
+
+// Record separator between JSON docs in list/keys output (never appears in
+// JSON text, so no escaping is needed).
+constexpr char kSep = '\x1e';
+
+char* dup_buf(const std::string& s, int64_t* out_len) {
+  char* p = static_cast<char*>(std::malloc(s.size() ? s.size() : 1));
+  if (p != nullptr && !s.empty()) std::memcpy(p, s.data(), s.size());
+  *out_len = static_cast<int64_t>(s.size());
+  return p;
+}
+
+// selector and labels are "k\x1Fv\x1Fk2\x1Fv2"; every selector pair must
+// appear in labels (subset match, the match_labels semantics).
+constexpr char kUnit = '\x1f';
+
+bool labels_match(const std::string& labels, const std::string& selector) {
+  size_t pos = 0;
+  while (pos < selector.size()) {
+    size_t key_end = selector.find(kUnit, pos);
+    if (key_end == std::string::npos) return false;  // malformed: odd fields
+    size_t val_end = selector.find(kUnit, key_end + 1);
+    if (val_end == std::string::npos) val_end = selector.size();
+    const std::string pair = selector.substr(pos, val_end - pos);
+    // find `pair` in labels aligned to pair boundaries
+    bool found = false;
+    size_t lpos = 0;
+    while (lpos < labels.size()) {
+      size_t lkey_end = labels.find(kUnit, lpos);
+      if (lkey_end == std::string::npos) break;
+      size_t lval_end = labels.find(kUnit, lkey_end + 1);
+      if (lval_end == std::string::npos) lval_end = labels.size();
+      if (labels.compare(lpos, lval_end - lpos, pair) == 0) {
+        found = true;
+        break;
+      }
+      lpos = lval_end + 1;
+    }
+    if (!found) return false;
+    pos = val_end + 1;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+enum NbsStatus {
+  NBS_OK = 0,
+  NBS_NOT_FOUND = 1,
+  NBS_EXISTS = 2,
+  NBS_NO_MEM = 3,
+};
+
+void* nbs_new() { return new (std::nothrow) Handle(); }
+
+void nbs_destroy(void* h) { delete static_cast<Handle*>(h); }
+
+uint64_t nbs_next_rv(void* h) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  return ++s->rv;
+}
+
+// Unconditional upsert (create-vs-update preconditions are enforced by the
+// Python store, which owns admission + optimistic-concurrency semantics).
+// ns/labels are pre-extracted metadata used for native-side list filtering;
+// labels is "k\x1Fv\x1Fk2\x1Fv2" (unit-separated pairs).
+int nbs_put(void* h, const char* bucket, const char* key, const char* json,
+            int64_t len, const char* ns, const char* labels) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  Entry& e = s->buckets[bucket].objs[key];
+  e.json.assign(json, static_cast<size_t>(len));
+  e.ns = ns ? ns : "";
+  e.labels = labels ? labels : "";
+  return NBS_OK;
+}
+
+int nbs_get(void* h, const char* bucket, const char* key, char** out,
+            int64_t* out_len) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto b = s->buckets.find(bucket);
+  if (b == s->buckets.end()) return NBS_NOT_FOUND;
+  auto it = b->second.objs.find(key);
+  if (it == b->second.objs.end()) return NBS_NOT_FOUND;
+  *out = dup_buf(it->second.json, out_len);
+  return *out ? NBS_OK : NBS_NO_MEM;
+}
+
+int nbs_pop(void* h, const char* bucket, const char* key, char** out,
+            int64_t* out_len) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto b = s->buckets.find(bucket);
+  if (b == s->buckets.end()) return NBS_NOT_FOUND;
+  auto it = b->second.objs.find(key);
+  if (it == b->second.objs.end()) return NBS_NOT_FOUND;
+  *out = dup_buf(it->second.json, out_len);
+  if (*out == nullptr) return NBS_NO_MEM;
+  b->second.objs.erase(it);
+  return NBS_OK;
+}
+
+int nbs_contains(void* h, const char* bucket, const char* key) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto b = s->buckets.find(bucket);
+  return b != s->buckets.end() && b->second.objs.count(key) ? 1 : 0;
+}
+
+int64_t nbs_count(void* h, const char* bucket) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto b = s->buckets.find(bucket);
+  return b == s->buckets.end() ? 0 : static_cast<int64_t>(b->second.objs.size());
+}
+
+// All values in key order, '\x1e'-separated, as one snapshot buffer.
+// has_ns != 0 filters to Entry.ns == ns; selector (same unit-separated pair
+// encoding as put) requires every pair to be present in Entry.labels — the
+// match happens here so Python never deserializes non-matching objects.
+int nbs_list(void* h, const char* bucket, int has_ns, const char* ns,
+             const char* selector, char** out, int64_t* out_len) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string joined;
+  auto b = s->buckets.find(bucket);
+  if (b != s->buckets.end()) {
+    const std::string want_ns = ns ? ns : "";
+    const std::string sel = selector ? selector : "";
+    for (const auto& kv : b->second.objs) {
+      const Entry& e = kv.second;
+      if (has_ns && e.ns != want_ns) continue;
+      if (!sel.empty() && !labels_match(e.labels, sel)) continue;
+      if (!joined.empty()) joined.push_back(kSep);
+      joined += e.json;
+    }
+  }
+  *out = dup_buf(joined, out_len);
+  return *out ? NBS_OK : NBS_NO_MEM;
+}
+
+// All bucket names that currently hold at least one object.
+int nbs_bucket_names(void* h, char** out, int64_t* out_len) {
+  auto* s = static_cast<Handle*>(h);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string joined;
+  for (const auto& kv : s->buckets) {
+    if (kv.second.objs.empty()) continue;
+    if (!joined.empty()) joined.push_back(kSep);
+    joined += kv.first;
+  }
+  *out = dup_buf(joined, out_len);
+  return *out ? NBS_OK : NBS_NO_MEM;
+}
+
+void nbs_buf_free(char* p) { std::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,211 @@
+"""ctypes loader for the native storage core (native/nbstore.cc).
+
+pybind11 is not available in this environment, so the binding is a plain C
+ABI over ctypes. The library is optional: `load()` returns None when the .so
+is absent (pure-Python fallback in cluster/store.py), and `ensure_built()`
+compiles it on demand when a toolchain is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_SO_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "libnbstore.so")
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+NBS_OK = 0
+NBS_NOT_FOUND = 1
+NBS_EXISTS = 2
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_char_pp = ctypes.POINTER(ctypes.c_char_p)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.nbs_new.restype = ctypes.c_void_p
+    lib.nbs_destroy.argtypes = [ctypes.c_void_p]
+    lib.nbs_next_rv.argtypes = [ctypes.c_void_p]
+    lib.nbs_next_rv.restype = ctypes.c_uint64
+    lib.nbs_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.nbs_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, c_char_pp, i64p
+    ]
+    lib.nbs_pop.argtypes = lib.nbs_get.argtypes
+    lib.nbs_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p]
+    lib.nbs_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.nbs_count.restype = ctypes.c_int64
+    lib.nbs_list.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_char_p, c_char_pp, i64p,
+    ]
+    lib.nbs_bucket_names.argtypes = [ctypes.c_void_p, c_char_pp, i64p]
+    lib.nbs_buf_free.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Compile (or incrementally rebuild) the library; True if the .so exists
+    afterwards. make owns staleness: a .so older than nbstore.cc is rebuilt,
+    so source edits are never silently ignored."""
+    if not os.path.isdir(_NATIVE_DIR):
+        return os.path.exists(_SO_PATH)
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            check=True,
+            capture_output=quiet,
+            timeout=120,
+        )
+    except Exception:
+        pass
+    return os.path.exists(_SO_PATH)
+
+
+def load(build_if_missing: bool = True) -> Optional[ctypes.CDLL]:
+    """The bound library, or None when unavailable. Cached."""
+    global _lib, _load_attempted
+    if _lib is not None:
+        return _lib
+    if _load_attempted and not os.path.exists(_SO_PATH):
+        return None
+    _load_attempted = True
+    if not os.path.exists(_SO_PATH) and build_if_missing:
+        ensure_built()
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        _lib = _bind(ctypes.CDLL(_SO_PATH))
+    except OSError:
+        return None
+    return _lib
+
+
+class _OwnedBuf:
+    """Scoped malloc'd buffer: copies to bytes, frees the C allocation."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self.lib = lib
+        self.ptr = ctypes.c_char_p()
+        self.size = ctypes.c_int64()
+
+    def take(self) -> bytes:
+        try:
+            raw = ctypes.string_at(self.ptr, self.size.value)
+        finally:
+            self.lib.nbs_buf_free(self.ptr)
+        return raw
+
+
+def _esc(s: str) -> str:
+    """Injective escape keeping the \\x1e/\\x1f separators out of label
+    text, so native pair-aligned matching stays exact for any input."""
+    return s.replace("\\", "\\\\").replace("\x1f", "\\u1f").replace("\x1e", "\\u1e")
+
+
+def encode_labels(labels: Optional[dict]) -> bytes:
+    """dict -> unit-separated escaped pairs (the nbs_put/nbs_list format)."""
+    if not labels:
+        return b""
+    return "\x1f".join(
+        f"{_esc(str(k))}\x1f{_esc(str(v))}" for k, v in labels.items()
+    ).encode()
+
+
+class NativeStore:
+    """Thin OO wrapper over the C ABI; values are canonical JSON bytes."""
+
+    def __init__(self) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("libnbstore.so unavailable (run `make -C native`)")
+        self._lib = lib
+        self._h = lib.nbs_new()
+        if not self._h:
+            raise MemoryError("nbs_new failed")
+
+    def __del__(self) -> None:  # pragma: no cover - interpreter teardown
+        h, self._h = getattr(self, "_h", None), None
+        if h and getattr(self, "_lib", None) is not None:
+            self._lib.nbs_destroy(h)
+
+    def next_rv(self) -> int:
+        return int(self._lib.nbs_next_rv(self._h))
+
+    def put(
+        self,
+        bucket: str,
+        key: str,
+        json_bytes: bytes,
+        namespace: str = "",
+        labels: Optional[dict] = None,
+    ) -> None:
+        self._lib.nbs_put(
+            self._h, bucket.encode(), key.encode(), json_bytes, len(json_bytes),
+            namespace.encode(), encode_labels(labels),
+        )
+
+    def get(self, bucket: str, key: str) -> Optional[bytes]:
+        buf = _OwnedBuf(self._lib)
+        rc = self._lib.nbs_get(
+            self._h, bucket.encode(), key.encode(),
+            ctypes.byref(buf.ptr), ctypes.byref(buf.size),
+        )
+        if rc != NBS_OK:
+            return None
+        return buf.take()
+
+    def pop(self, bucket: str, key: str) -> Optional[bytes]:
+        buf = _OwnedBuf(self._lib)
+        rc = self._lib.nbs_pop(
+            self._h, bucket.encode(), key.encode(),
+            ctypes.byref(buf.ptr), ctypes.byref(buf.size),
+        )
+        if rc != NBS_OK:
+            return None
+        return buf.take()
+
+    def contains(self, bucket: str, key: str) -> bool:
+        return bool(self._lib.nbs_contains(self._h, bucket.encode(), key.encode()))
+
+    def count(self, bucket: str) -> int:
+        return int(self._lib.nbs_count(self._h, bucket.encode()))
+
+    def list(
+        self,
+        bucket: str,
+        namespace: Optional[str] = None,
+        selector: Optional[dict] = None,
+    ) -> list:
+        """Values in key order; namespace/label filtering happens natively."""
+        buf = _OwnedBuf(self._lib)
+        rc = self._lib.nbs_list(
+            self._h, bucket.encode(),
+            0 if namespace is None else 1,
+            (namespace or "").encode(),
+            encode_labels(selector),
+            ctypes.byref(buf.ptr), ctypes.byref(buf.size),
+        )
+        if rc != NBS_OK:
+            return []
+        raw = buf.take()
+        return raw.split(b"\x1e") if raw else []
+
+    def bucket_names(self) -> list:
+        buf = _OwnedBuf(self._lib)
+        rc = self._lib.nbs_bucket_names(
+            self._h, ctypes.byref(buf.ptr), ctypes.byref(buf.size)
+        )
+        if rc != NBS_OK:
+            return []
+        raw = buf.take()
+        return [b.decode() for b in raw.split(b"\x1e")] if raw else []

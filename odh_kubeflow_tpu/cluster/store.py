@@ -125,14 +125,140 @@ class Watch:
             yield ev
 
 
-class Store:
-    """The versioned object store. Keys: (storage_api_version, kind) -> {ns/name -> dict}."""
+def _to_json(obj: Dict[str, Any]) -> str:
+    """Serialize to canonical JSON — the store's data contract (API objects
+    ARE JSON documents, as in etcd). Non-JSON values (sets, datetimes, NaN)
+    raise InvalidError; non-string dict keys are coerced to strings, exactly
+    as any JSON API server would."""
+    try:
+        return json.dumps(obj, separators=(",", ":"), allow_nan=False)
+    except (TypeError, ValueError) as e:
+        raise InvalidError(f"object is not canonical JSON: {e}") from e
 
-    def __init__(self, scheme: Scheme = default_scheme):
+
+class _PyBucket:
+    """Canonical-JSON bucket, pure Python. Value semantics: every read
+    deserializes a fresh dict, every write serializes — so callers can never
+    alias stored state. Identical contract to _NativeBucket."""
+
+    def __init__(self) -> None:
+        self._objs: Dict[str, str] = {}
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._objs
+
+    def __getitem__(self, key: str) -> Dict[str, Any]:
+        return json.loads(self._objs[key])
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        raw = self._objs.get(key)
+        return None if raw is None else json.loads(raw)
+
+    def raw(self, key: str) -> str:
+        return self._objs[key]
+
+    def store(self, key: str, obj: Dict[str, Any]) -> str:
+        """Serialize once; returns the canonical form for local reuse."""
+        raw = _to_json(obj)
+        self._objs[key] = raw
+        return raw
+
+    def __setitem__(self, key: str, obj: Dict[str, Any]) -> None:
+        self.store(key, obj)
+
+    def pop(self, key: str) -> Dict[str, Any]:
+        return json.loads(self._objs.pop(key))
+
+    def values(self) -> Iterable[Dict[str, Any]]:
+        return [json.loads(raw) for raw in self._objs.values()]
+
+
+class _NativeBucket:
+    """Same contract, backed by the C++ storage core (native/nbstore.cc)."""
+
+    def __init__(self, native: Any, name: str) -> None:
+        self._native = native
+        self._name = name
+
+    def __contains__(self, key: str) -> bool:
+        return self._native.contains(self._name, key)
+
+    def __getitem__(self, key: str) -> Dict[str, Any]:
+        raw = self._native.get(self._name, key)
+        if raw is None:
+            raise KeyError(key)
+        return json.loads(raw)
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        raw = self._native.get(self._name, key)
+        return None if raw is None else json.loads(raw)
+
+    def raw(self, key: str) -> str:
+        raw = self._native.get(self._name, key)
+        if raw is None:
+            raise KeyError(key)
+        return raw.decode()
+
+    def store(self, key: str, obj: Dict[str, Any]) -> str:
+        """Serialize once; returns the canonical form for local reuse."""
+        raw = _to_json(obj)
+        meta = obj.get("metadata", {})
+        self._native.put(
+            self._name,
+            key,
+            raw.encode(),
+            namespace=meta.get("namespace", "") or "",
+            labels=meta.get("labels") or None,
+        )
+        return raw
+
+    def __setitem__(self, key: str, obj: Dict[str, Any]) -> None:
+        self.store(key, obj)
+
+    def pop(self, key: str) -> Dict[str, Any]:
+        raw = self._native.pop(self._name, key)
+        if raw is None:
+            raise KeyError(key)
+        return json.loads(raw)
+
+    def values(self) -> Iterable[Dict[str, Any]]:
+        return [json.loads(raw) for raw in self._native.list(self._name)]
+
+    def list_filtered(
+        self, namespace: Optional[str], selector: Optional[Dict[str, str]]
+    ) -> List[Dict[str, Any]]:
+        """Filtering runs in the C++ core; only matches are deserialized."""
+        return [
+            json.loads(raw)
+            for raw in self._native.list(self._name, namespace, selector)
+        ]
+
+
+class Store:
+    """The versioned object store. Keys: (storage_api_version, kind) -> {ns/name -> obj}.
+
+    Storage backend: `backend="native"` keeps object bytes in the C++ core
+    (the compiled storage engine, the build's etcd analog); `"python"` keeps
+    them in an in-process dict with the same canonical-JSON value semantics;
+    `"auto"` (default) uses native when the library is loadable."""
+
+    def __init__(self, scheme: Scheme = default_scheme, backend: str = "auto"):
         self.scheme = scheme
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
-        self._objects: Dict[Tuple[str, str], Dict[str, Dict[str, Any]]] = {}
+        self._native = None
+        if backend not in ("auto", "native", "python"):
+            raise ValueError(f"unknown store backend {backend!r}")
+        if backend in ("auto", "native"):
+            try:
+                from .._native import NativeStore
+
+                self._native = NativeStore()
+            except Exception:
+                if backend == "native":
+                    raise
+        self.backend = "native" if self._native is not None else "python"
+        self._objects: Dict[Tuple[str, str], Any] = {}
         self._watchers: Dict[Tuple[str, str], List[queue.Queue]] = {}
         self._webhooks: List[_WebhookRegistration] = []
         self._gc_enabled = True
@@ -142,14 +268,24 @@ class Store:
     def _storage_key(self, api_version: str, kind: str) -> Tuple[str, str]:
         return _STORAGE_KEY_OVERRIDES.get((api_version, kind), (api_version, kind))
 
-    def _bucket(self, api_version: str, kind: str) -> Dict[str, Dict[str, Any]]:
-        return self._objects.setdefault(self._storage_key(api_version, kind), {})
+    def _bucket(self, api_version: str, kind: str) -> Any:
+        skey = self._storage_key(api_version, kind)
+        bucket = self._objects.get(skey)
+        if bucket is None:
+            if self._native is not None:
+                bucket = _NativeBucket(self._native, f"{skey[0]}|{skey[1]}")
+            else:
+                bucket = _PyBucket()
+            self._objects[skey] = bucket
+        return bucket
 
     @staticmethod
     def _obj_key(namespace: str, name: str) -> str:
         return f"{namespace}/{name}" if namespace else name
 
     def _next_rv(self) -> str:
+        if self._native is not None:
+            return str(self._native.next_rv())
         return str(next(self._rv))
 
     def _emit(self, api_version: str, kind: str, ev: WatchEvent) -> None:
@@ -213,9 +349,9 @@ class Store:
             meta["generation"] = 1
             meta["creationTimestamp"] = now_rfc3339()
             meta.pop("deletionTimestamp", None)
-            bucket[key] = copy.deepcopy(obj)
-            self._emit(av, kind, WatchEvent(ADDED, copy.deepcopy(obj)))
-            return copy.deepcopy(obj)
+            raw = bucket.store(key, obj)  # one serialization; never aliases obj
+            self._emit(av, kind, WatchEvent(ADDED, json.loads(raw)))
+            return json.loads(raw)
 
     def get_raw(self, api_version: str, kind: str, namespace: str, name: str) -> Dict[str, Any]:
         with self._lock:
@@ -223,7 +359,7 @@ class Store:
             key = self._obj_key(namespace, name)
             if key not in bucket:
                 raise NotFoundError(kind=kind, name=key)
-            return copy.deepcopy(bucket[key])
+            return bucket[key]  # fresh deserialization = snapshot copy
 
     def list_raw(
         self,
@@ -233,14 +369,18 @@ class Store:
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
         with self._lock:
-            out = []
-            for key, obj in self._bucket(api_version, kind).items():
-                meta = obj.get("metadata", {})
-                if namespace is not None and meta.get("namespace", "") != namespace:
-                    continue
-                if not match_labels(label_selector, meta.get("labels")):
-                    continue
-                out.append(copy.deepcopy(obj))
+            bucket = self._bucket(api_version, kind)
+            if isinstance(bucket, _NativeBucket):
+                out = bucket.list_filtered(namespace, label_selector)
+            else:
+                out = []
+                for obj in bucket.values():
+                    meta = obj.get("metadata", {})
+                    if namespace is not None and meta.get("namespace", "") != namespace:
+                        continue
+                    if not match_labels(label_selector, meta.get("labels")):
+                        continue
+                    out.append(obj)
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
             return out
 
@@ -254,7 +394,8 @@ class Store:
             key = self._obj_key(ns, name)
             if key not in bucket:
                 raise NotFoundError(kind=kind, name=key)
-            current = bucket[key]
+            current_raw = bucket.raw(key)
+            current = json.loads(current_raw)
             cur_meta = current["metadata"]
             if meta.get("resourceVersion") and meta["resourceVersion"] != cur_meta["resourceVersion"]:
                 raise ConflictError(
@@ -262,7 +403,7 @@ class Store:
                     f"the object has been modified"
                 )
             if subresource == "status":
-                merged = copy.deepcopy(current)
+                merged = current  # already a snapshot copy from the bucket
                 if "status" in obj:
                     merged["status"] = obj["status"]
                 else:
@@ -271,12 +412,14 @@ class Store:
                 merged = obj
                 # status is a subresource: plain updates cannot change it
                 if "status" in current:
-                    merged["status"] = copy.deepcopy(current["status"])
+                    merged["status"] = current["status"]
                 else:
                     merged.pop("status", None)
                 merged = self._run_admission(
                     AdmissionRequest(
-                        operation="UPDATE", object=merged, old_object=copy.deepcopy(current)
+                        operation="UPDATE",
+                        object=merged,
+                        old_object=json.loads(current_raw),
                     )
                 )
             mmeta = merged.setdefault("metadata", {})
@@ -293,10 +436,12 @@ class Store:
             ) != json.dumps(current.get("spec"), sort_keys=True):
                 gen += 1
             mmeta["generation"] = gen
-            bucket[key] = copy.deepcopy(merged)
-            self._emit(av, kind, WatchEvent(MODIFIED, copy.deepcopy(merged)))
+            raw = bucket.store(key, merged)
+            self._emit(av, kind, WatchEvent(MODIFIED, json.loads(raw)))
             self._finalize_if_ready(av, kind, bucket, key)
-            return copy.deepcopy(bucket.get(key, merged))
+            # finalize may have removed the object; either way `raw` is the
+            # state this update produced
+            return json.loads(raw)
 
     def patch_raw(
         self,
@@ -324,18 +469,19 @@ class Store:
             key = self._obj_key(namespace, name)
             if key not in bucket:
                 raise NotFoundError(kind=kind, name=key)
-            obj = bucket[key]
+            obj = bucket[key]  # snapshot copy: changes must be written back
             meta = obj["metadata"]
             if meta.get("finalizers"):
                 if not meta.get("deletionTimestamp"):
                     meta["deletionTimestamp"] = now_rfc3339()
                     meta["resourceVersion"] = self._next_rv()
-                    self._emit(api_version, kind, WatchEvent(MODIFIED, copy.deepcopy(obj)))
+                    bucket[key] = obj
+                    self._emit(api_version, kind, WatchEvent(MODIFIED, obj))
                 return
             self._remove(api_version, kind, bucket, key)
 
     def _finalize_if_ready(
-        self, api_version: str, kind: str, bucket: Dict[str, Dict[str, Any]], key: str
+        self, api_version: str, kind: str, bucket: Any, key: str
     ) -> None:
         """If deletionTimestamp is set and finalizers are now empty, remove."""
         obj = bucket.get(key)
@@ -345,11 +491,9 @@ class Store:
         if meta.get("deletionTimestamp") and not meta.get("finalizers"):
             self._remove(api_version, kind, bucket, key)
 
-    def _remove(
-        self, api_version: str, kind: str, bucket: Dict[str, Dict[str, Any]], key: str
-    ) -> None:
+    def _remove(self, api_version: str, kind: str, bucket: Any, key: str) -> None:
         obj = bucket.pop(key)
-        self._emit(api_version, kind, WatchEvent(DELETED, copy.deepcopy(obj)))
+        self._emit(api_version, kind, WatchEvent(DELETED, obj))
         if self._gc_enabled:
             self._cascade_delete(obj)
 

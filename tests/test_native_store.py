@@ -1,0 +1,274 @@
+"""Native storage core: backend parity, ctypes binding, fallback."""
+import json
+
+import pytest
+
+from odh_kubeflow_tpu._native import ensure_built, load
+from odh_kubeflow_tpu.cluster.store import Store
+
+HAVE_NATIVE = ensure_built() and load() is not None
+
+needs_native = pytest.mark.skipif(not HAVE_NATIVE, reason="libnbstore.so unavailable")
+
+
+def _lifecycle(store: Store) -> list:
+    """One scripted CRUD+finalizer+GC sequence; returns observable states."""
+    out = []
+    owner = store.create_raw(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "own", "namespace": "ns", "finalizers": ["keep"]},
+            "data": {"k": "v"},
+        }
+    )
+    child = store.create_raw(
+        {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {
+                "name": "dep",
+                "namespace": "ns",
+                "ownerReferences": [
+                    {"apiVersion": "v1", "kind": "ConfigMap", "name": "own",
+                     "uid": owner["metadata"]["uid"]}
+                ],
+            },
+        }
+    )
+    out.append(("rv_distinct", owner["metadata"]["resourceVersion"]
+                != child["metadata"]["resourceVersion"]))
+    got = store.get_raw("v1", "ConfigMap", "ns", "own")
+    got["data"]["k"] = "v2"
+    updated = store.update_raw(got)
+    out.append(("update_data", updated["data"]["k"]))
+    # snapshot isolation: mutating a returned object must not touch the store
+    updated["data"]["k"] = "corrupted"
+    out.append(("isolated", store.get_raw("v1", "ConfigMap", "ns", "own")["data"]["k"]))
+    store.delete_raw("v1", "ConfigMap", "ns", "own")
+    pending = store.get_raw("v1", "ConfigMap", "ns", "own")
+    out.append(("deletion_pending", bool(pending["metadata"].get("deletionTimestamp"))))
+    pending["metadata"]["finalizers"] = []
+    store.update_raw(pending)
+    out.append(("owner_gone", "own" not in [
+        o["metadata"]["name"] for o in store.list_raw("v1", "ConfigMap", namespace="ns")
+    ]))
+    out.append(("child_gced", store.list_raw("v1", "Secret", namespace="ns") == []))
+    return out
+
+
+@needs_native
+def test_native_backend_selected_by_default():
+    assert Store().backend == "native"
+
+
+def test_python_backend_forced():
+    assert Store(backend="python").backend == "python"
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(ValueError):
+        Store(backend="etcd")
+
+
+@pytest.mark.parametrize("backend", ["python"] + (["native"] if HAVE_NATIVE else []))
+def test_non_json_object_rejected_cleanly(backend):
+    """Canonical-JSON contract: sets/NaN raise InvalidError (never a bare
+    TypeError mid-write); non-string keys coerce to strings, as JSON does."""
+    from odh_kubeflow_tpu.apimachinery import InvalidError
+
+    store = Store(backend=backend)
+    for bad in [{"when": {1, 2}}, {"n": float("nan")}]:
+        with pytest.raises(InvalidError):
+            store.create_raw(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": "bad", "namespace": "ns"},
+                    "data": bad,
+                }
+            )
+    created = store.create_raw(
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "coerced", "namespace": "ns"},
+            "data": {1: "x"},
+        }
+    )
+    assert created["data"] == {"1": "x"}
+
+
+@needs_native
+def test_backend_parity_full_lifecycle():
+    assert _lifecycle(Store(backend="native")) == _lifecycle(Store(backend="python"))
+
+
+def test_python_lifecycle_semantics():
+    states = dict(_lifecycle(Store(backend="python")))
+    assert states == {
+        "rv_distinct": True,
+        "update_data": "v2",
+        "isolated": "v2",
+        "deletion_pending": True,
+        "owner_gone": True,
+        "child_gced": True,
+    }
+
+
+@needs_native
+def test_native_store_raw_binding():
+    from odh_kubeflow_tpu._native import NativeStore
+
+    s = NativeStore()
+    assert s.next_rv() == 1
+    payload = json.dumps({"big": "x" * 10000}).encode()
+    s.put("b", "k", payload)
+    assert s.get("b", "k") == payload
+    assert s.list("b") == [payload]
+    assert s.pop("b", "k") == payload
+    assert s.get("b", "k") is None
+    assert s.count("b") == 0
+
+
+@needs_native
+def test_native_list_is_key_ordered():
+    from odh_kubeflow_tpu._native import NativeStore
+
+    s = NativeStore()
+    for name in ["zz", "aa", "mm"]:
+        s.put("b", name, json.dumps({"n": name}).encode())
+    assert [json.loads(r)["n"] for r in s.list("b")] == ["aa", "mm", "zz"]
+
+
+def _seed_labeled(store, n=60):
+    for i in range(n):
+        store.create_raw(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {
+                    "name": f"cm-{i:03d}",
+                    "namespace": f"ns-{i % 3}",
+                    "labels": {"app": f"app-{i % 5}", "tier": "web" if i % 2 else "db"},
+                },
+                "data": {"i": str(i)},
+            }
+        )
+
+
+@needs_native
+def test_filtered_list_parity_with_python_backend():
+    native, python = Store(backend="native"), Store(backend="python")
+    for s in (native, python):
+        _seed_labeled(s)
+    cases = [
+        dict(namespace=None, label_selector=None),
+        dict(namespace="ns-1", label_selector=None),
+        dict(namespace=None, label_selector={"app": "app-2"}),
+        dict(namespace="ns-0", label_selector={"app": "app-0", "tier": "db"}),
+        dict(namespace="nope", label_selector=None),
+        dict(namespace=None, label_selector={"app": "missing"}),
+    ]
+    def ident(objs):
+        return [
+            (o["metadata"]["namespace"], o["metadata"]["name"], o["data"])
+            for o in objs
+        ]
+
+    for kw in cases:
+        a = native.list_raw("v1", "ConfigMap", **kw)
+        b = python.list_raw("v1", "ConfigMap", **kw)
+        assert ident(a) == ident(b), kw
+    assert len(native.list_raw("v1", "ConfigMap", namespace="ns-1")) == 20
+
+
+@needs_native
+def test_filtered_list_handles_separator_chars_in_labels():
+    """The \\x1e/\\x1f encoding must stay exact for hostile label text."""
+    native, python = Store(backend="native"), Store(backend="python")
+    weird = {"k": "a\x1fb", "k2": "c\x1ed", "k3": "back\\slash"}
+    for s in (native, python):
+        s.create_raw(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": "w", "namespace": "ns", "labels": dict(weird)},
+            }
+        )
+        s.create_raw(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                # label value that would collide if escaping were not injective
+                "metadata": {"name": "x", "namespace": "ns",
+                             "labels": {"k": "a", "fake": "b"}},
+            }
+        )
+    for sel in [dict(weird), {"k": "a\x1fb"}, {"k": "a"}, {"k": "a", "fake": "b"}]:
+        a = native.list_raw("v1", "ConfigMap", label_selector=sel)
+        b = python.list_raw("v1", "ConfigMap", label_selector=sel)
+        assert [o["metadata"]["name"] for o in a] == [
+            o["metadata"]["name"] for o in b
+        ], sel
+
+
+@needs_native
+def test_native_store_throughput_exceeds_python(capsys):
+    """Informational microbench (no hard assert — CI machines vary)."""
+    import time
+
+    def bench(store):
+        t0 = time.perf_counter()
+        n = 300
+        for i in range(n):
+            store.create_raw(
+                {
+                    "apiVersion": "v1",
+                    "kind": "ConfigMap",
+                    "metadata": {"name": f"cm-{i}", "namespace": "ns"},
+                    "data": {"payload": "x" * 256},
+                }
+            )
+        for i in range(n):
+            obj = store.get_raw("v1", "ConfigMap", "ns", f"cm-{i}")
+            obj["data"]["payload"] = "y" * 256
+            store.update_raw(obj)
+        store.list_raw("v1", "ConfigMap", namespace="ns")
+        return time.perf_counter() - t0
+
+    t_native = bench(Store(backend="native"))
+    t_python = bench(Store(backend="python"))
+
+    def bench_selective_list(store):
+        import time
+
+        for ns in range(20):
+            for i in range(50):
+                store.create_raw(
+                    {
+                        "apiVersion": "v1",
+                        "kind": "Secret",
+                        "metadata": {"name": f"s-{i}", "namespace": f"ns-{ns}",
+                                     "labels": {"notebook-name": f"nb-{i}"}},
+                        "data": {"blob": "z" * 2048},
+                    }
+                )
+        t0 = time.perf_counter()
+        for _ in range(50):
+            out = store.list_raw(
+                "v1", "Secret", namespace="ns-7",
+                label_selector={"notebook-name": "nb-3"},
+            )
+            assert len(out) == 1
+        return time.perf_counter() - t0
+
+    tl_native = bench_selective_list(Store(backend="native"))
+    tl_python = bench_selective_list(Store(backend="python"))
+    with capsys.disabled():
+        print(
+            f"\n[native-store bench] crud: native={t_native:.3f}s "
+            f"python={t_python:.3f}s | selective list x50 over 1000 objs: "
+            f"native={tl_native:.3f}s python={tl_python:.3f}s "
+            f"({tl_python / max(tl_native, 1e-9):.1f}x)"
+        )

@@ -132,7 +132,9 @@ def test_tpu_multihost_v5p32(env):
     assert env_d["JAX_NUM_PROCESSES"] == "4"
     assert any(e.name == "TPU_WORKER_ID" and e.value_from for e in c.env)
 
-    hosts_svc = cluster.client.get(Service, "user", "train-hosts")
+    hosts_svc = wait_for(
+        lambda: cluster.client.get(Service, "user", "train-hosts"), msg="hosts svc"
+    )
     assert hosts_svc.spec.cluster_ip == "None"
 
     nb = wait_for(
