@@ -1,0 +1,208 @@
+"""Loadtest: template N Notebook(+PVC) CRs and measure controller behavior.
+
+The reference's loadtest (notebook-controller/loadtest/start_notebooks.py:51-96)
+templates N Notebook+PVC pairs and kubectl-applies them at a live cluster. This
+harness does the same against the in-process cluster — so it actually measures
+(create storm -> all slices mesh-ready, p50/p95/max) — or, with --emit, prints
+the templated CRs as YAML for kubectl against a real cluster.
+
+  python loadtest/start_notebooks.py --notebooks 50
+  python loadtest/start_notebooks.py --notebooks 20 --accelerator v5p --topology 2x2x4
+  python loadtest/start_notebooks.py --notebooks 3 --emit | kubectl apply -f -
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def template_notebook(name: str, namespace: str, accelerator: str, topology: str,
+                      image: str, pvc: bool):
+    docs = []
+    if pvc:
+        docs.append(
+            {
+                "apiVersion": "v1",
+                "kind": "PersistentVolumeClaim",
+                "metadata": {"name": f"{name}-volume", "namespace": namespace},
+                "spec": {
+                    "accessModes": ["ReadWriteOnce"],
+                    "resources": {"requests": {"storage": "10Gi"}},
+                },
+            }
+        )
+    spec = {
+        "template": {
+            "spec": {
+                "containers": [
+                    {
+                        "name": name,
+                        "image": image,
+                        "volumeMounts": (
+                            [{"name": "workspace", "mountPath": "/home/jovyan"}]
+                            if pvc
+                            else []
+                        ),
+                    }
+                ],
+                "volumes": (
+                    [
+                        {
+                            "name": "workspace",
+                            "persistentVolumeClaim": {"claimName": f"{name}-volume"},
+                        }
+                    ]
+                    if pvc
+                    else []
+                ),
+            }
+        }
+    }
+    if accelerator:
+        spec["tpu"] = {"accelerator": accelerator, "topology": topology}
+    docs.append(
+        {
+            "apiVersion": "kubeflow.org/v1beta1",
+            "kind": "Notebook",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": spec,
+        }
+    )
+    return docs
+
+
+def emit(args) -> None:
+    import yaml
+
+    docs = []
+    for i in range(args.notebooks):
+        docs += template_notebook(
+            f"{args.prefix}{i}", args.namespace, args.accelerator, args.topology,
+            args.image, pvc=not args.no_pvc,
+        )
+    for d in docs:
+        sys.stdout.write("---\n")
+        yaml.safe_dump(d, sys.stdout, sort_keys=False)
+
+
+def run_sim(args) -> None:
+    from odh_kubeflow_tpu.api.notebook import Notebook
+    from odh_kubeflow_tpu.apimachinery import default_scheme
+    from odh_kubeflow_tpu.cluster import PodDecision, SimCluster
+    from odh_kubeflow_tpu.controllers import Config, constants as C
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.probe import KernelState, NotebookAgent, SimTPUMonitor
+    from odh_kubeflow_tpu.tpu import TPU_RESOURCE, plan_slice
+
+    cluster = SimCluster().start()
+    agents = {}
+
+    def behavior(pod):
+        if not pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
+            return None
+        key = (pod.metadata.name, pod.metadata.uid)
+        if key not in agents:
+            chips = sum(
+                int((c.resources.requests or {}).get(TPU_RESOURCE, "0") or 0)
+                for c in pod.spec.containers
+            )
+            kernels = KernelState()
+            kernels.set_busy()
+            agents[key] = NotebookAgent(
+                monitor=SimTPUMonitor(chips=chips, expected=chips, duty=0.9),
+                kernels=kernels,
+            )
+        return PodDecision(serve=lambda p: agents[key].serve())
+
+    cluster.add_pod_behavior(behavior)
+    if args.accelerator:
+        shape = plan_slice(args.accelerator, topology=args.topology)
+        cluster.add_tpu_pool(
+            "load", args.accelerator, args.topology, slices=args.notebooks
+        )
+        chips_per_nb = shape.chips
+    else:
+        cluster.add_cpu_pool("load", nodes=max(1, args.notebooks // 8))
+        chips_per_nb = 0
+
+    mgr = build_manager(cluster.store, Config(), http_get=cluster.http_get)
+    mgr.start()
+    t0 = {}
+    try:
+        created = time.monotonic()
+        for i in range(args.notebooks):
+            name = f"{args.prefix}{i}"
+            for doc in template_notebook(
+                name, args.namespace, args.accelerator, args.topology, args.image,
+                pvc=not args.no_pvc,
+            ):
+                t0[name] = time.monotonic()
+                cluster.client.create(default_scheme.decode(doc))
+        storm_s = time.monotonic() - created
+
+        latencies = {}
+        deadline = time.monotonic() + args.timeout
+        pending = {f"{args.prefix}{i}" for i in range(args.notebooks)}
+        while pending and time.monotonic() < deadline:
+            for name in list(pending):
+                nb = cluster.client.get(Notebook, args.namespace, name)
+                ready = (
+                    nb.status.tpu.mesh_ready
+                    if (args.accelerator and nb.status.tpu)
+                    else nb.status.ready_replicas >= 1
+                )
+                if ready:
+                    latencies[name] = time.monotonic() - t0[name]
+                    pending.discard(name)
+            time.sleep(0.005)
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+    vals = sorted(latencies.values())
+    result = {
+        "notebooks": args.notebooks,
+        "ready": len(vals),
+        "timed_out": args.notebooks - len(vals),
+        "create_storm_s": round(storm_s, 4),
+        "chips_bound": chips_per_nb * len(vals),
+        "ready_p50_s": round(statistics.median(vals), 4) if vals else None,
+        "ready_p95_s": round(vals[int(0.95 * (len(vals) - 1))], 4) if vals else None,
+        "ready_max_s": round(vals[-1], 4) if vals else None,
+    }
+    print(json.dumps(result))
+    if result["timed_out"]:
+        raise SystemExit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--notebooks", type=int, default=3)  # reference default
+    ap.add_argument("--namespace", default="loadtest")
+    ap.add_argument("--prefix", default="loadtest-nb-")
+    ap.add_argument("--image", default="jupyter-tpu:latest")
+    ap.add_argument("--accelerator", default="v5e")
+    ap.add_argument("--topology", default="2x2")
+    ap.add_argument("--no-pvc", action="store_true")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--emit", action="store_true", help="print CR YAML and exit")
+    args = ap.parse_args()
+    if args.accelerator in ("", "none", "cpu"):
+        args.accelerator = ""
+    try:
+        if args.emit:
+            emit(args)
+        else:
+            run_sim(args)
+    except BrokenPipeError:  # `--emit | head` etc.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,264 @@
+"""e2e tier: the FULL operator (composition root, all controllers + webhook)
+against the in-process cluster, mirroring the reference e2e suite's structure
+(reference odh-notebook-controller/e2e/: setup fixtures incl. an auth/RBAC
+variant, creation -> routing -> network policy -> StatefulSet -> auth sidecar
+-> live HTTP traffic through the route backend -> culling; update blocking;
+deletion cleanup). The reference needs a live OpenShift cluster and a 3-min
+budget per resource; here the same flow runs in-process in seconds.
+"""
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.apps import StatefulSet
+from odh_kubeflow_tpu.api.core import Container, Pod, Service
+from odh_kubeflow_tpu.api.gateway import HTTPRoute, ReferenceGrant
+from odh_kubeflow_tpu.api.networking import NetworkPolicy
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.api.rbac import ClusterRoleBinding
+from odh_kubeflow_tpu.apimachinery import NotFoundError
+from odh_kubeflow_tpu.cluster import PodDecision, SimCluster
+from odh_kubeflow_tpu.controllers import Config, constants as C
+from odh_kubeflow_tpu.controllers.extension import auth_service_name, route_name
+from odh_kubeflow_tpu.main import build_manager
+from odh_kubeflow_tpu.probe import KernelState, NotebookAgent, SimTPUMonitor
+from odh_kubeflow_tpu.tpu import TPU_RESOURCE
+
+CTRL_NS = "tpu-notebooks-system"
+NS = "e2e-user"
+
+# reference e2e: 3-min creation timeout / 10 s poll; in-process: 30 s / 50 ms
+TIMEOUT = 30
+
+
+def wait_for(fn, timeout=TIMEOUT, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+        except NotFoundError:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def gone(fn, timeout=TIMEOUT, msg="gone"):
+    def check():
+        try:
+            fn()
+            return False
+        except NotFoundError:
+            return True
+
+    return wait_for(check, timeout=timeout, msg=msg)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    """testContext analog (reference e2e/notebook_controller_setup_test.go:62-128):
+    one cluster + full manager for the whole module; notebooks are fixtures."""
+    cluster = SimCluster().start()
+    cluster.add_cpu_pool("cpu", nodes=2)
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=4)
+    agents = {}
+
+    def behavior(pod):
+        nb_name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
+        if not nb_name:
+            return None
+        key = (pod.metadata.name, pod.metadata.uid)
+        if key not in agents:
+            chips = sum(
+                int((c.resources.requests or {}).get(TPU_RESOURCE, "0") or 0)
+                for c in pod.spec.containers
+            )
+            kernels = KernelState()
+            kernels.set_busy()
+            agents[key] = NotebookAgent(
+                monitor=SimTPUMonitor(chips=chips, expected=chips, duty=0.8),
+                kernels=kernels,
+            )
+            agents[pod.metadata.name] = agents[key]
+        return PodDecision(serve=lambda p: agents[key].serve())
+
+    cluster.add_pod_behavior(behavior)
+    config = Config(
+        controller_namespace=CTRL_NS,
+        enable_culling=True,
+        cull_idle_time_min=2.0 / 60.0,  # 2 s idle threshold
+        idleness_check_period_min=0.1 / 60.0,
+        set_pipeline_rbac=True,
+    )
+    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    mgr.start()
+    yield cluster, agents
+    mgr.stop()
+    cluster.stop()
+
+
+def mk_nb(name, annotations=None, tpu=None):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = NS
+    nb.metadata.annotations = dict(annotations or {})
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    nb.spec.tpu = tpu or TPUSpec(accelerator="v5e", topology="2x2")
+    return nb
+
+
+def test_creation_to_running_with_routing_and_policies(ctx):
+    """reference notebook_creation_test.go:31-83 equivalent."""
+    cluster, agents = ctx
+    cluster.client.create(mk_nb("plain"))
+
+    sts = wait_for(lambda: cluster.client.get(StatefulSet, NS, "plain"), msg="sts")
+    c = sts.spec.template.spec.containers[0]
+    assert (c.resources.requests or {}).get(TPU_RESOURCE) == "4"
+
+    route = wait_for(
+        lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(mk_nb("plain"))),
+        msg="httproute",
+    )
+    assert route.spec.rules[0].matches[0].path.value == f"/notebook/{NS}/plain"
+    wait_for(lambda: cluster.client.get(ReferenceGrant, NS, "notebook-httproute-access"),
+             msg="referencegrant")
+    wait_for(lambda: cluster.client.get(NetworkPolicy, NS, "plain-ctrl-np"), msg="np")
+
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(cluster.client.get(Notebook, NS, "plain")),
+        msg="mesh ready",
+    )
+    assert nb.status.ready_replicas == 1
+    assert nb.status.tpu.chips_visible == 4
+
+
+def test_live_traffic_through_route_backend(ctx):
+    """The reference drives real HTTP through the Gateway
+    (e2e/helper_test.go:103-120); here the route's backendRef is resolved
+    through cluster DNS to the pod's real socket."""
+    cluster, agents = ctx
+    route = wait_for(
+        lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(mk_nb("plain"))),
+        msg="route",
+    )
+    backend = route.spec.rules[0].backend_refs[0]
+    assert backend.namespace == NS
+    url = f"http://{backend.name}.{NS}.svc.cluster.local:{backend.port}/api/kernels"
+    status, body = wait_for(
+        lambda: cluster.http_get(url), msg="traffic through backend"
+    )
+    assert status == 200
+    assert b"[" in body  # Jupyter kernels JSON list
+
+
+def test_auth_variant_sidecar_and_rbac_objects(ctx):
+    """reference setup's RBAC fixture notebook + kube-rbac-proxy assertions."""
+    cluster, agents = ctx
+    cluster.client.create(
+        mk_nb("secured", annotations={C.INJECT_AUTH_ANNOTATION: "true"})
+    )
+    sts = wait_for(lambda: cluster.client.get(StatefulSet, NS, "secured"), msg="sts")
+    names = [c.name for c in sts.spec.template.spec.containers]
+    assert "kube-rbac-proxy" in names
+
+    wait_for(lambda: cluster.client.get(Service, NS, auth_service_name("secured")),
+             msg="auth svc")
+    nb = cluster.client.get(Notebook, NS, "secured")
+    from odh_kubeflow_tpu.controllers.extension import auth_binding_name
+
+    wait_for(lambda: cluster.client.get(ClusterRoleBinding, "", auth_binding_name(nb)),
+             msg="crb")
+    route = wait_for(
+        lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(nb)), msg="route"
+    )
+    # auth mode retargets the route to the proxy service
+    assert route.spec.rules[0].backend_refs[0].name == auth_service_name("secured")
+    wait_for(lambda: cluster.client.get(NetworkPolicy, NS, "secured-kube-rbac-proxy-np"),
+             msg="proxy np")
+
+
+def test_update_blocked_while_running(ctx):
+    """reference notebook_update_test.go: webhook-caused diffs must not
+    restart a running notebook; update-pending annotation records it."""
+    cluster, agents = ctx
+    wait_for(
+        lambda: cluster.client.get(Notebook, NS, "plain").status.ready_replicas == 1,
+        msg="running",
+    )
+    sts_uid = cluster.client.get(StatefulSet, NS, "plain").metadata.uid
+    # flip auth on for a RUNNING notebook: webhook-caused podspec change
+    cluster.client.patch(
+        Notebook, NS, "plain",
+        {"metadata": {"annotations": {C.INJECT_AUTH_ANNOTATION: "true"}}},
+    )
+    nb = wait_for(
+        lambda: (
+            lambda n: n
+            if C.UPDATE_PENDING_ANNOTATION in n.metadata.annotations
+            else None
+        )(cluster.client.get(Notebook, NS, "plain")),
+        msg="update-pending",
+    )
+    # podspec reverted: no sidecar materialized, same StatefulSet generation
+    sts = cluster.client.get(StatefulSet, NS, "plain")
+    assert [c.name for c in sts.spec.template.spec.containers] == ["plain"]
+    assert sts.metadata.uid == sts_uid
+
+
+def test_culling_stops_idle_notebook_and_frees_slice(ctx):
+    """reference notebook_creation_test.go culling leg + TPU-native signal:
+    idle kernels AND idle TPU -> replicas 0, slice freed."""
+    cluster, agents = ctx
+    cluster.client.create(mk_nb("dormant"))
+    wait_for(
+        lambda: cluster.client.get(Notebook, NS, "dormant").status.ready_replicas == 1,
+        msg="running",
+    )
+    agent = agents["dormant-0"]
+    agent.kernels.set_idle(time.time() - 3600)
+    agent.monitor.duty = 0.0
+    wait_for(
+        lambda: C.STOP_ANNOTATION
+        in cluster.client.get(Notebook, NS, "dormant").metadata.annotations,
+        msg="stop annotation",
+    )
+    wait_for(
+        lambda: cluster.client.get(StatefulSet, NS, "dormant").spec.replicas == 0,
+        msg="scaled to zero",
+    )
+    gone(lambda: cluster.client.get(Pod, NS, "dormant-0"), msg="pod reclaimed")
+
+
+def test_deletion_cleans_everything(ctx):
+    """reference notebook_deletion_test.go: CR delete -> owned objects GC'd,
+    cross-namespace + cluster-scoped objects finalizer-cleaned."""
+    cluster, agents = ctx
+    nb = cluster.client.get(Notebook, NS, "secured")
+    from odh_kubeflow_tpu.controllers.extension import auth_binding_name
+
+    crb_name = auth_binding_name(nb)
+    cluster.client.delete(Notebook, NS, "secured")
+    gone(lambda: cluster.client.get(Notebook, NS, "secured"), msg="nb gone")
+    gone(lambda: cluster.client.get(StatefulSet, NS, "secured"), msg="sts gone")
+    gone(lambda: cluster.client.get(HTTPRoute, CTRL_NS, route_name(nb)), msg="route gone")
+    gone(lambda: cluster.client.get(ClusterRoleBinding, "", crb_name), msg="crb gone")
+    # ReferenceGrant survives: "plain"/"dormant" still live in the namespace
+    assert cluster.client.get(ReferenceGrant, NS, "notebook-httproute-access")
+
+
+def test_pytorch_xla_runtime_env(ctx):
+    """BASELINE config #4: torch-xla SPMD env injected end-to-end."""
+    cluster, agents = ctx
+    cluster.client.create(
+        mk_nb("torch", tpu=TPUSpec(accelerator="v5e", topology="2x2",
+                                   runtime="pytorch-xla"))
+    )
+    sts = wait_for(lambda: cluster.client.get(StatefulSet, NS, "torch"), msg="sts")
+    env = {e.name: e.value for e in sts.spec.template.spec.containers[0].env if e.value}
+    assert env["PJRT_DEVICE"] == "TPU"
+    assert env["XLA_USE_SPMD"] == "1"
+    assert "JAX_PLATFORMS" not in env
