@@ -13,6 +13,7 @@ version. Responsibilities, in handler order (mirroring Handle :352-499):
 - resolve the image from the workbench image catalog ConfigMap when the
   `last-image-selection` annotation is present (ImageStream analog :787-894),
 - mount the CA bundle ConfigMap when present (:618-781),
+- mount/unmount the Feast client config by label (:432-444),
 - inject the auth proxy sidecar when `inject-auth` is set, with
   annotation-tunable, validated resources (:177-326, :126-173),
 - inject cluster egress-proxy env when enabled (:566-615),
@@ -54,6 +55,8 @@ IMAGE_CATALOG_CONFIGMAP = "notebook-images"
 PROXY_CONFIGMAP = "cluster-proxy-config"
 AUTH_PROXY_CONTAINER = "kube-rbac-proxy"
 AUTH_PROXY_PORT = 8443
+FEAST_VOLUME = "feast-config"
+FEAST_MOUNT_PATH = "/opt/app-root/src/feast-config"
 
 
 class NotebookWebhook:
@@ -89,6 +92,10 @@ class NotebookWebhook:
             self.validate_tpu(nb, span)
             self.set_container_image_from_catalog(nb, span)
             self.check_and_mount_ca_bundle(nb)
+            if nb.metadata.labels.get(C.FEAST_LABEL) == "true":
+                self.mount_feast_config(nb)
+            else:
+                self.unmount_feast_config(nb)
             if nb.metadata.annotations.get(C.INJECT_AUTH_ANNOTATION) == "true":
                 self.inject_auth_proxy(nb)
             else:
@@ -102,6 +109,38 @@ class NotebookWebhook:
             return nb.to_dict()
 
     # ---------- mutations ----------
+
+    def mount_feast_config(self, nb: Notebook) -> None:
+        """Label `opendatahub.io/feast-integration=true` mounts the
+        `{name}-feast-config` ConfigMap at the Feast client path in the
+        primary container (reference notebook_feast_config.go:53-117)."""
+        container = self._primary_container(nb)
+        if container is None:
+            return
+        podspec = nb.spec.template.spec
+        if podspec.volume(FEAST_VOLUME) is None:
+            podspec.volumes.append(
+                Volume(
+                    name=FEAST_VOLUME,
+                    config_map={
+                        "name": f"{nb.metadata.name}-feast-config",
+                        "optional": True,
+                    },
+                )
+            )
+        if not any(m.name == FEAST_VOLUME for m in container.volume_mounts):
+            container.volume_mounts.append(
+                VolumeMount(name=FEAST_VOLUME, mount_path=FEAST_MOUNT_PATH)
+            )
+
+    def unmount_feast_config(self, nb: Notebook) -> None:
+        """Label removed ⇒ volume + mounts go away (reference :120-146)."""
+        podspec = nb.spec.template.spec
+        podspec.volumes = [v for v in podspec.volumes if v.name != FEAST_VOLUME]
+        for container in podspec.containers:
+            container.volume_mounts = [
+                m for m in container.volume_mounts if m.name != FEAST_VOLUME
+            ]
 
     def inject_reconciliation_lock(self, nb: Notebook) -> None:
         """The webhook<->extension-controller handshake: replicas stay 0 until

@@ -229,3 +229,39 @@ def test_proxy_env_user_lowercase_wins():
     env_d = created.spec.template.spec.containers[0].env_dict()
     assert env_d["http_proxy"] == "http://corp:8080"
     assert "HTTP_PROXY" not in env_d
+
+
+def test_feast_config_mounted_by_label_and_unmounted_on_removal(env):
+    store, client, _ = env
+    from odh_kubeflow_tpu.controllers.webhook import FEAST_MOUNT_PATH, FEAST_VOLUME
+
+    nb = mk_nb("feasty")
+    nb.metadata.labels[C.FEAST_LABEL] = "true"
+    created = client.create(nb)
+    podspec = created.spec.template.spec
+    assert podspec.volume(FEAST_VOLUME) is not None
+    assert podspec.volume(FEAST_VOLUME).config_map["name"] == "feasty-feast-config"
+    mounts = [m for m in podspec.containers[0].volume_mounts if m.name == FEAST_VOLUME]
+    assert mounts and mounts[0].mount_path == FEAST_MOUNT_PATH
+
+    # label removed -> webhook unmounts on the next update
+    created.metadata.labels.pop(C.FEAST_LABEL)
+    updated = client.update(created)
+    podspec = updated.spec.template.spec
+    assert podspec.volume(FEAST_VOLUME) is None
+    assert all(m.name != FEAST_VOLUME for m in podspec.containers[0].volume_mounts)
+
+
+def test_feast_mount_idempotent(env):
+    store, client, _ = env
+    from odh_kubeflow_tpu.controllers.webhook import FEAST_VOLUME
+
+    nb = mk_nb("feast2")
+    nb.metadata.labels[C.FEAST_LABEL] = "true"
+    created = client.create(nb)
+    updated = client.update(created)  # webhook runs again on UPDATE
+    podspec = updated.spec.template.spec
+    assert len([v for v in podspec.volumes if v.name == FEAST_VOLUME]) == 1
+    assert len(
+        [m for m in podspec.containers[0].volume_mounts if m.name == FEAST_VOLUME]
+    ) == 1
