@@ -66,13 +66,15 @@ def _flash_kernel(
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     def _fold():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
+        # Inputs stay in their native (bf16) dtype so the MXU runs at full
+        # rate; accumulation is f32 via preferred_element_type. The scale is
+        # applied to the f32 scores, not the operands.
         s = jax.lax.dot_general(
-            q,
-            k_ref[0].astype(jnp.float32),
+            q_ref[0],
+            k_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
+        ) * sm_scale  # (block_q, block_k)
         if causal:
             qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -84,8 +86,8 @@ def _flash_kernel(
         p = jnp.exp(s - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p,
-            v_ref[0].astype(jnp.float32),
+            p.astype(v_ref.dtype),  # bf16 PV matmul, f32 accumulate (standard flash)
+            v_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
