@@ -23,11 +23,10 @@ import time
 
 from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
 from odh_kubeflow_tpu.api.core import Container
-from odh_kubeflow_tpu.cluster import PodDecision, SimCluster
-from odh_kubeflow_tpu.controllers import Config, constants as C
+from odh_kubeflow_tpu.cluster import SimCluster
+from odh_kubeflow_tpu.controllers import Config
 from odh_kubeflow_tpu.main import build_manager
-from odh_kubeflow_tpu.probe import KernelState, NotebookAgent, SimTPUMonitor
-from odh_kubeflow_tpu.tpu import TPU_RESOURCE
+from odh_kubeflow_tpu.probe import sim_agent_behavior
 
 SINGLE_HOST_NOTEBOOKS = 16  # v5e-4 each
 MULTI_HOST_NOTEBOOKS = 4  # v5p-32 each (4 hosts x 4 chips)
@@ -46,24 +45,7 @@ def make_notebook(name: str, accelerator: str, topology: str) -> Notebook:
 def main() -> None:
     cluster = SimCluster().start()
     agents = {}
-
-    def behavior(pod):
-        if not pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
-            return None
-        key = (pod.metadata.name, pod.metadata.uid)
-        if key not in agents:
-            chips = 0
-            for c in pod.spec.containers:
-                chips += int((c.resources.requests or {}).get(TPU_RESOURCE, "0") or 0)
-            kernels = KernelState()
-            kernels.set_busy()
-            agents[key] = NotebookAgent(
-                monitor=SimTPUMonitor(chips=chips, expected=chips, duty=0.9),
-                kernels=kernels,
-            )
-        return PodDecision(serve=lambda p: agents[key].serve())
-
-    cluster.add_pod_behavior(behavior)
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
     cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=SINGLE_HOST_NOTEBOOKS)
     cluster.add_tpu_pool("v5p", "v5p", "2x2x4", slices=MULTI_HOST_NOTEBOOKS)
 

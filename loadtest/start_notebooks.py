@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import statistics
 import sys
@@ -94,33 +95,15 @@ def emit(args) -> None:
 def run_sim(args) -> None:
     from odh_kubeflow_tpu.api.notebook import Notebook
     from odh_kubeflow_tpu.apimachinery import default_scheme
-    from odh_kubeflow_tpu.cluster import PodDecision, SimCluster
-    from odh_kubeflow_tpu.controllers import Config, constants as C
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config
     from odh_kubeflow_tpu.main import build_manager
-    from odh_kubeflow_tpu.probe import KernelState, NotebookAgent, SimTPUMonitor
-    from odh_kubeflow_tpu.tpu import TPU_RESOURCE, plan_slice
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.tpu import plan_slice
 
     cluster = SimCluster().start()
     agents = {}
-
-    def behavior(pod):
-        if not pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
-            return None
-        key = (pod.metadata.name, pod.metadata.uid)
-        if key not in agents:
-            chips = sum(
-                int((c.resources.requests or {}).get(TPU_RESOURCE, "0") or 0)
-                for c in pod.spec.containers
-            )
-            kernels = KernelState()
-            kernels.set_busy()
-            agents[key] = NotebookAgent(
-                monitor=SimTPUMonitor(chips=chips, expected=chips, duty=0.9),
-                kernels=kernels,
-            )
-        return PodDecision(serve=lambda p: agents[key].serve())
-
-    cluster.add_pod_behavior(behavior)
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
     if args.accelerator:
         shape = plan_slice(args.accelerator, topology=args.topology)
         cluster.add_tpu_pool(
@@ -173,7 +156,11 @@ def run_sim(args) -> None:
         "create_storm_s": round(storm_s, 4),
         "chips_bound": chips_per_nb * len(vals),
         "ready_p50_s": round(statistics.median(vals), 4) if vals else None,
-        "ready_p95_s": round(vals[int(0.95 * (len(vals) - 1))], 4) if vals else None,
+        "ready_p95_s": (
+            round(vals[min(len(vals) - 1, math.ceil(0.95 * (len(vals) - 1)))], 4)
+            if vals
+            else None
+        ),
         "ready_max_s": round(vals[-1], 4) if vals else None,
     }
     print(json.dumps(result))

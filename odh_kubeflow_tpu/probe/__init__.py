@@ -1,4 +1,5 @@
 from .agent import (
+    sim_agent_behavior,
     JaxTPUMonitor,
     KernelState,
     NotebookAgent,

@@ -228,3 +228,46 @@ class NotebookAgent:
         if self._server is not None:
             self._server.shutdown()
             self._server = None
+
+
+def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
+                       kernels_busy: bool = True, chips: Optional[int] = None):
+    """Kubelet-sim pod behavior running one NotebookAgent per notebook pod.
+
+    The shared fixture for tests, bench.py and the loadtest: caches one agent
+    per (pod name, uid) — the kubelet calls the behavior on every reconcile,
+    so the served state and the caller's handle must not diverge — and
+    aliases it under the bare pod name for scripting (`agents["nb-0"]`).
+    Chips default to the pod's `google.com/tpu` request."""
+    from ..controllers import constants as C
+    from ..tpu import TPU_RESOURCE
+
+    def behavior(pod):
+        if not pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
+            return None
+        key = (pod.metadata.name, pod.metadata.uid)
+        if key not in agents:
+            n_chips = chips
+            if n_chips is None:
+                n_chips = sum(
+                    int((c.resources.requests or {}).get(TPU_RESOURCE, "0") or 0)
+                    for c in pod.spec.containers
+                )
+            kernels = KernelState()
+            if kernels_busy:
+                kernels.set_busy()
+            else:
+                kernels.set_idle(time.time())
+            agent = NotebookAgent(
+                monitor=SimTPUMonitor(chips=n_chips, expected=n_chips, duty=duty),
+                kernels=kernels,
+            )
+            agents[key] = agent
+            agents[pod.metadata.name] = agent
+        agent = agents[key]
+
+        from ..cluster.kubelet import PodDecision
+
+        return PodDecision(serve=lambda p: agent.serve())
+
+    return behavior

@@ -17,11 +17,11 @@ from odh_kubeflow_tpu.api.networking import NetworkPolicy
 from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
 from odh_kubeflow_tpu.api.rbac import ClusterRoleBinding
 from odh_kubeflow_tpu.apimachinery import NotFoundError
-from odh_kubeflow_tpu.cluster import PodDecision, SimCluster
+from odh_kubeflow_tpu.cluster import SimCluster
 from odh_kubeflow_tpu.controllers import Config, constants as C
 from odh_kubeflow_tpu.controllers.extension import auth_service_name, route_name
 from odh_kubeflow_tpu.main import build_manager
-from odh_kubeflow_tpu.probe import KernelState, NotebookAgent, SimTPUMonitor
+from odh_kubeflow_tpu.probe import sim_agent_behavior
 from odh_kubeflow_tpu.tpu import TPU_RESOURCE
 
 CTRL_NS = "tpu-notebooks-system"
@@ -63,27 +63,7 @@ def ctx():
     cluster.add_cpu_pool("cpu", nodes=2)
     cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=4)
     agents = {}
-
-    def behavior(pod):
-        nb_name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
-        if not nb_name:
-            return None
-        key = (pod.metadata.name, pod.metadata.uid)
-        if key not in agents:
-            chips = sum(
-                int((c.resources.requests or {}).get(TPU_RESOURCE, "0") or 0)
-                for c in pod.spec.containers
-            )
-            kernels = KernelState()
-            kernels.set_busy()
-            agents[key] = NotebookAgent(
-                monitor=SimTPUMonitor(chips=chips, expected=chips, duty=0.8),
-                kernels=kernels,
-            )
-            agents[pod.metadata.name] = agents[key]
-        return PodDecision(serve=lambda p: agents[key].serve())
-
-    cluster.add_pod_behavior(behavior)
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.8))
     config = Config(
         controller_namespace=CTRL_NS,
         enable_culling=True,

@@ -14,7 +14,7 @@ from odh_kubeflow_tpu.controllers import (
     NotebookReconciler,
     constants as C,
 )
-from odh_kubeflow_tpu.probe import KernelState, NotebookAgent, SimTPUMonitor
+from odh_kubeflow_tpu.probe import sim_agent_behavior
 from odh_kubeflow_tpu.runtime import Manager
 
 FAST = Config(
@@ -35,24 +35,9 @@ def env():
 
     # every notebook pod runs a real agent; tests script its state
     agents = {}
-
-    def behavior(pod):
-        # NB: called on every kubelet reconcile -> must reuse one agent per
-        # pod uid or the served state and the test's handle diverge
-        nb_name = pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
-        if not nb_name:
-            return None
-        cache_key = (pod.metadata.name, pod.metadata.uid)
-        if cache_key not in agents:
-            kernels = KernelState()
-            kernels.set_idle(time.time())
-            monitor = SimTPUMonitor(chips=4, expected=4, duty=0.0)
-            agents[cache_key] = NotebookAgent(monitor=monitor, kernels=kernels)
-            agents[pod.metadata.name] = (kernels, monitor)
-        agent = agents[cache_key]
-        return PodDecision(serve=lambda p: agent.serve())
-
-    cluster.add_pod_behavior(behavior)
+    cluster.add_pod_behavior(
+        sim_agent_behavior(agents, duty=0.0, kernels_busy=False, chips=4)
+    )
     mgr.start()
     yield cluster, mgr, agents
     mgr.stop()
@@ -115,7 +100,7 @@ def test_busy_kernel_prevents_culling(env):
     cluster, mgr, agents = env
     cluster.client.create(mk_nb("worker"))
     wait_for(lambda: "worker-0" in agents, msg="pod up")
-    agents["worker-0"][0].set_busy()
+    agents["worker-0"].kernels.set_busy()
     time.sleep(2.5)  # several cull windows
     assert C.STOP_ANNOTATION not in get_nb(cluster, "worker").metadata.annotations
 
@@ -125,15 +110,15 @@ def test_tpu_busy_blocks_cull_despite_idle_kernels(env):
     cluster, mgr, agents = env
     cluster.client.create(mk_nb("trainer", tpu=TPUSpec(accelerator="v5e", topology="2x2")))
     wait_for(lambda: "trainer-0" in agents, msg="pod up")
-    kernels, monitor = agents["trainer-0"]
-    kernels.set_idle(time.time() - 3600)  # kernels idle for an hour
-    monitor.duty = 0.9  # slice is hot
-    monitor.last_busy_ts = time.time()
+    agent = agents["trainer-0"]
+    agent.kernels.set_idle(time.time() - 3600)  # kernels idle for an hour
+    agent.monitor.duty = 0.9  # slice is hot
+    agent.monitor.last_busy_ts = time.time()
     time.sleep(2.5)
     assert C.STOP_ANNOTATION not in get_nb(cluster, "trainer").metadata.annotations
 
     # slice cools down -> cull proceeds
-    monitor.duty = 0.0
+    agent.monitor.duty = 0.0
     wait_for(
         lambda: C.STOP_ANNOTATION in get_nb(cluster, "trainer").metadata.annotations,
         msg="culled after TPU idle",
@@ -158,7 +143,7 @@ def test_unstop_restarts_cull_cycle(env):
     wait_for(
         lambda: agents.get("cycle-0") not in (None, old_handle), msg="new pod back"
     )
-    agents["cycle-0"][0].set_busy()
+    agents["cycle-0"].kernels.set_busy()
     wait_for(
         lambda: get_nb(cluster, "cycle").status.ready_replicas == 1, msg="ready again"
     )
