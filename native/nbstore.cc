@@ -108,10 +108,16 @@ int nbs_put(void* h, const char* bucket, const char* key, const char* json,
             int64_t len, const char* ns, const char* labels) {
   auto* s = static_cast<Handle*>(h);
   std::lock_guard<std::mutex> g(s->mu);
-  Entry& e = s->buckets[bucket].objs[key];
-  e.json.assign(json, static_cast<size_t>(len));
-  e.ns = ns ? ns : "";
-  e.labels = labels ? labels : "";
+  try {
+    Entry& e = s->buckets[bucket].objs[key];
+    e.json.assign(json, static_cast<size_t>(len));
+    e.ns = ns ? ns : "";
+    e.labels = labels ? labels : "";
+  } catch (const std::bad_alloc&) {
+    // bad_alloc must not cross the C ABI (std::terminate); report it so the
+    // Python side can raise MemoryError instead of aborting the process.
+    return NBS_NO_MEM;
+  }
   return NBS_OK;
 }
 

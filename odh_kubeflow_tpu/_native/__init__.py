@@ -24,6 +24,13 @@ _load_attempted = False
 NBS_OK = 0
 NBS_NOT_FOUND = 1
 NBS_EXISTS = 2
+NBS_NO_MEM = 3
+
+
+def _check_rc(rc: int, what: str) -> None:
+    """Allocation failure must surface as an error, never as not-found."""
+    if rc == NBS_NO_MEM:
+        raise MemoryError(f"native store: allocation failed in {what}")
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -149,10 +156,11 @@ class NativeStore:
         namespace: str = "",
         labels: Optional[dict] = None,
     ) -> None:
-        self._lib.nbs_put(
+        rc = self._lib.nbs_put(
             self._h, bucket.encode(), key.encode(), json_bytes, len(json_bytes),
             namespace.encode(), encode_labels(labels),
         )
+        _check_rc(rc, "nbs_put")
 
     def get(self, bucket: str, key: str) -> Optional[bytes]:
         buf = _OwnedBuf(self._lib)
@@ -161,6 +169,7 @@ class NativeStore:
             ctypes.byref(buf.ptr), ctypes.byref(buf.size),
         )
         if rc != NBS_OK:
+            _check_rc(rc, "nbs_get")
             return None
         return buf.take()
 
@@ -171,6 +180,7 @@ class NativeStore:
             ctypes.byref(buf.ptr), ctypes.byref(buf.size),
         )
         if rc != NBS_OK:
+            _check_rc(rc, "nbs_pop")
             return None
         return buf.take()
 
@@ -196,6 +206,7 @@ class NativeStore:
             ctypes.byref(buf.ptr), ctypes.byref(buf.size),
         )
         if rc != NBS_OK:
+            _check_rc(rc, "nbs_list")
             return []
         raw = buf.take()
         return raw.split(b"\x1e") if raw else []
@@ -206,6 +217,7 @@ class NativeStore:
             self._h, ctypes.byref(buf.ptr), ctypes.byref(buf.size)
         )
         if rc != NBS_OK:
+            _check_rc(rc, "nbs_bucket_names")
             return []
         raw = buf.take()
         return [b.decode() for b in raw.split(b"\x1e")] if raw else []

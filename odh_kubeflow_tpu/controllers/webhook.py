@@ -55,7 +55,9 @@ IMAGE_CATALOG_CONFIGMAP = "notebook-images"
 PROXY_CONFIGMAP = "cluster-proxy-config"
 AUTH_PROXY_CONTAINER = "kube-rbac-proxy"
 AUTH_PROXY_PORT = 8443
-FEAST_VOLUME = "feast-config"
+# Distinctive prefixed name (reference notebook_feast_config.go:27) so
+# unmount can never collide with a user-defined volume.
+FEAST_VOLUME = "odh-feast-config"
 FEAST_MOUNT_PATH = "/opt/app-root/src/feast-config"
 
 
@@ -110,37 +112,57 @@ class NotebookWebhook:
 
     # ---------- mutations ----------
 
+    @staticmethod
+    def _remove_volume_and_mounts(podspec, name: str) -> None:
+        podspec.volumes = [v for v in podspec.volumes if v.name != name]
+        for container in podspec.containers:
+            container.volume_mounts = [
+                m for m in container.volume_mounts if m.name != name
+            ]
+
+    def _strip_legacy_feast_volume(self, nb: Notebook) -> Optional[dict]:
+        """Migrate specs admitted under the pre-rename volume name
+        'feast-config' — but only when the volume is identifiably ours
+        (backed by the `{name}-feast-config` ConfigMap), so a user volume
+        that happens to share the generic name is never touched. Returns the
+        legacy volume's configMap source so the re-mount can preserve its
+        optionality for workloads that relied on it."""
+        podspec = nb.spec.template.spec
+        legacy = podspec.volume("feast-config")
+        if legacy is None or (legacy.config_map or {}).get("name") != (
+            f"{nb.metadata.name}-feast-config"
+        ):
+            return None
+        self._remove_volume_and_mounts(podspec, "feast-config")
+        return legacy.config_map
+
     def mount_feast_config(self, nb: Notebook) -> None:
         """Label `opendatahub.io/feast-integration=true` mounts the
         `{name}-feast-config` ConfigMap at the Feast client path in the
         primary container (reference notebook_feast_config.go:53-117)."""
+        legacy_source = self._strip_legacy_feast_volume(nb)
         container = self._primary_container(nb)
         if container is None:
             return
         podspec = nb.spec.template.spec
         if podspec.volume(FEAST_VOLUME) is None:
-            podspec.volumes.append(
-                Volume(
-                    name=FEAST_VOLUME,
-                    config_map={
-                        "name": f"{nb.metadata.name}-feast-config",
-                        "optional": True,
-                    },
-                )
-            )
+            # required, like the reference: a missing ConfigMap should hold
+            # the pod at ContainerCreating, not start without it. Migrated
+            # legacy volumes keep their source verbatim (incl. optional:true)
+            # so previously-working pods are never retroactively wedged.
+            source = legacy_source or {"name": f"{nb.metadata.name}-feast-config"}
+            podspec.volumes.append(Volume(name=FEAST_VOLUME, config_map=source))
         if not any(m.name == FEAST_VOLUME for m in container.volume_mounts):
             container.volume_mounts.append(
-                VolumeMount(name=FEAST_VOLUME, mount_path=FEAST_MOUNT_PATH)
+                VolumeMount(
+                    name=FEAST_VOLUME, mount_path=FEAST_MOUNT_PATH, read_only=True
+                )
             )
 
     def unmount_feast_config(self, nb: Notebook) -> None:
         """Label removed ⇒ volume + mounts go away (reference :120-146)."""
-        podspec = nb.spec.template.spec
-        podspec.volumes = [v for v in podspec.volumes if v.name != FEAST_VOLUME]
-        for container in podspec.containers:
-            container.volume_mounts = [
-                m for m in container.volume_mounts if m.name != FEAST_VOLUME
-            ]
+        self._strip_legacy_feast_volume(nb)
+        self._remove_volume_and_mounts(nb.spec.template.spec, FEAST_VOLUME)
 
     def inject_reconciliation_lock(self, nb: Notebook) -> None:
         """The webhook<->extension-controller handshake: replicas stay 0 until

@@ -146,21 +146,9 @@ def _gke_patches(p: Dict[str, str]) -> List[Dict[str, Any]]:
 
 
 def _dev_patches(p: Dict[str, str]) -> List[Dict[str, Any]]:
+    # culler cadence only: a Deployment merge-patch would replace the
+    # containers list wholesale, so dev mode never patches the manager pod
     return [
-        {
-            "kind": "Deployment",
-            "metadata": {"name": "tpu-notebook-controller-manager"},
-            "spec": {
-                "template": {
-                    "spec": {
-                        "containers": [
-                            # merge patch replaces the list wholesale; dev mode
-                            # is rendered via env overlay instead
-                        ]
-                    }
-                }
-            },
-        },
         {
             "kind": "ConfigMap",
             "metadata": {"name": "notebook-controller-culler-config"},
@@ -177,7 +165,7 @@ OVERLAYS: Dict[str, Overlay] = {
     "dev": Overlay(
         "dev",
         params={"namespace": "tpu-notebooks-dev"},
-        patcher=lambda p: _dev_patches(p)[1:],  # culler cadence only
+        patcher=_dev_patches,
     ),
 }
 

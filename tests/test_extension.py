@@ -13,6 +13,7 @@ from odh_kubeflow_tpu.api.rbac import ClusterRoleBinding, Role, RoleBinding
 from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
 from odh_kubeflow_tpu.apimachinery import NotFoundError
 from odh_kubeflow_tpu.cluster import SimCluster
+from odh_kubeflow_tpu.cluster.client import retry_on_conflict
 from odh_kubeflow_tpu.controllers import Config, constants as C
 from odh_kubeflow_tpu.controllers.extension import (
     REFERENCE_GRANT_NAME,
@@ -222,9 +223,12 @@ def test_ca_bundle_assembled_and_mounted(env):
         Notebook, "user", "certd",
         {"metadata": {"annotations": {C.STOP_ANNOTATION: "x"}}},
     )
-    nb = cluster.client.get(Notebook, "user", "certd")
-    nb.spec.template.spec.containers[0].image = "jax:2"
-    cluster.client.update(nb)
+    def bump_image():
+        nb = cluster.client.get(Notebook, "user", "certd")
+        nb.spec.template.spec.containers[0].image = "jax:2"
+        return cluster.client.update(nb)
+
+    retry_on_conflict(bump_image)  # races controller status writes
     nb = cluster.client.get(Notebook, "user", "certd")
     assert nb.spec.template.spec.volume("trusted-ca") is not None
 

@@ -265,3 +265,67 @@ def test_feast_mount_idempotent(env):
     assert len(
         [m for m in podspec.containers[0].volume_mounts if m.name == FEAST_VOLUME]
     ) == 1
+
+
+def test_feast_legacy_volume_migrated_user_volume_kept(env):
+    """Specs admitted under the pre-rename volume name 'feast-config' are
+    migrated, but only when the volume is identifiably ours; a user volume
+    sharing the generic name is never touched."""
+    store, client, _ = env
+    from odh_kubeflow_tpu.api.core import Volume, VolumeMount
+    from odh_kubeflow_tpu.controllers.webhook import FEAST_VOLUME
+
+    nb = mk_nb("legacy")
+    nb.metadata.labels[C.FEAST_LABEL] = "true"
+    # simulate a spec mutated by the old webhook: legacy name, our ConfigMap
+    nb.spec.template.spec.volumes.append(
+        Volume(name="feast-config", config_map={"name": "legacy-feast-config"})
+    )
+    nb.spec.template.spec.containers[0].volume_mounts.append(
+        VolumeMount(name="feast-config", mount_path="/opt/app-root/src/feast-config")
+    )
+    # plus a genuinely user-owned volume with the generic name pattern
+    nb.spec.template.spec.volumes.append(
+        Volume(name="feast-config-user", config_map={"name": "my-own-cm"})
+    )
+    created = client.create(nb)
+    podspec = created.spec.template.spec
+    assert podspec.volume("feast-config") is None  # legacy migrated away
+    assert podspec.volume(FEAST_VOLUME) is not None  # re-mounted under new name
+    assert podspec.volume("feast-config-user") is not None  # user volume kept
+    paths = [m.mount_path for m in podspec.containers[0].volume_mounts]
+    assert paths.count("/opt/app-root/src/feast-config") == 1  # no duplicate mountPath
+
+
+def test_feast_legacy_volume_not_ours_untouched(env):
+    store, client, _ = env
+    from odh_kubeflow_tpu.api.core import Volume
+
+    nb = mk_nb("legacy2")
+    # no feast label; a user volume named 'feast-config' backed by their own CM
+    nb.spec.template.spec.volumes.append(
+        Volume(name="feast-config", config_map={"name": "users-own-feast"})
+    )
+    created = client.create(nb)
+    assert created.spec.template.spec.volume("feast-config") is not None
+
+
+def test_feast_legacy_optional_volume_keeps_optionality(env):
+    """Migration must not retroactively tighten optional->required: a legacy
+    notebook whose ConfigMap never existed kept starting because the volume
+    was optional; the migrated volume preserves that source verbatim."""
+    store, client, _ = env
+    from odh_kubeflow_tpu.api.core import Volume
+    from odh_kubeflow_tpu.controllers.webhook import FEAST_VOLUME
+
+    nb = mk_nb("legacy3")
+    nb.metadata.labels[C.FEAST_LABEL] = "true"
+    nb.spec.template.spec.volumes.append(
+        Volume(
+            name="feast-config",
+            config_map={"name": "legacy3-feast-config", "optional": True},
+        )
+    )
+    created = client.create(nb)
+    vol = created.spec.template.spec.volume(FEAST_VOLUME)
+    assert vol is not None and vol.config_map.get("optional") is True
