@@ -109,10 +109,14 @@ int nbs_put(void* h, const char* bucket, const char* key, const char* json,
   auto* s = static_cast<Handle*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   try {
-    Entry& e = s->buckets[bucket].objs[key];
+    // Build the entry fully before touching the map: a bad_alloc mid-assign
+    // must not leave a phantom empty entry behind (a later get would feed
+    // b"" to json.loads and a create-retry would see AlreadyExists).
+    Entry e;
     e.json.assign(json, static_cast<size_t>(len));
     e.ns = ns ? ns : "";
     e.labels = labels ? labels : "";
+    s->buckets[bucket].objs[key] = std::move(e);
   } catch (const std::bad_alloc&) {
     // bad_alloc must not cross the C ABI (std::terminate); report it so the
     // Python side can raise MemoryError instead of aborting the process.
@@ -170,17 +174,23 @@ int nbs_list(void* h, const char* bucket, int has_ns, const char* ns,
   auto* s = static_cast<Handle*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   std::string joined;
-  auto b = s->buckets.find(bucket);
-  if (b != s->buckets.end()) {
-    const std::string want_ns = ns ? ns : "";
-    const std::string sel = selector ? selector : "";
-    for (const auto& kv : b->second.objs) {
-      const Entry& e = kv.second;
-      if (has_ns && e.ns != want_ns) continue;
-      if (!sel.empty() && !labels_match(e.labels, sel)) continue;
-      if (!joined.empty()) joined.push_back(kSep);
-      joined += e.json;
+  try {
+    auto b = s->buckets.find(bucket);
+    if (b != s->buckets.end()) {
+      const std::string want_ns = ns ? ns : "";
+      const std::string sel = selector ? selector : "";
+      for (const auto& kv : b->second.objs) {
+        const Entry& e = kv.second;
+        if (has_ns && e.ns != want_ns) continue;
+        if (!sel.empty() && !labels_match(e.labels, sel)) continue;
+        if (!joined.empty()) joined.push_back(kSep);
+        joined += e.json;
+      }
     }
+  } catch (const std::bad_alloc&) {
+    // the concatenation buffer is the library's largest allocation — OOM here
+    // must surface as NBS_NO_MEM, not std::terminate across the C ABI
+    return NBS_NO_MEM;
   }
   *out = dup_buf(joined, out_len);
   return *out ? NBS_OK : NBS_NO_MEM;
@@ -191,10 +201,14 @@ int nbs_bucket_names(void* h, char** out, int64_t* out_len) {
   auto* s = static_cast<Handle*>(h);
   std::lock_guard<std::mutex> g(s->mu);
   std::string joined;
-  for (const auto& kv : s->buckets) {
-    if (kv.second.objs.empty()) continue;
-    if (!joined.empty()) joined.push_back(kSep);
-    joined += kv.first;
+  try {
+    for (const auto& kv : s->buckets) {
+      if (kv.second.objs.empty()) continue;
+      if (!joined.empty()) joined.push_back(kSep);
+      joined += kv.first;
+    }
+  } catch (const std::bad_alloc&) {
+    return NBS_NO_MEM;
   }
   *out = dup_buf(joined, out_len);
   return *out ? NBS_OK : NBS_NO_MEM;

@@ -4,8 +4,10 @@ from .errors import (
     ApiError,
     ConflictError,
     ForbiddenError,
+    GoneError,
     InvalidError,
     NotFoundError,
+    UnauthorizedError,
     ignore_not_found,
     is_already_exists,
     is_conflict,
@@ -26,6 +28,7 @@ from .meta import (
     sanitize_name,
     set_condition,
 )
-from .patch import annotation_patch, json_merge_patch
+from .patch import annotation_patch, json_merge_patch, json_patch_apply, json_patch_diff
+from .restmapper import RESTMapper, RESTMapping, default_rest_mapper, pluralize
 from .scheme import Scheme, default_scheme
 from .serde import KubeModel, jfield, snake_to_camel

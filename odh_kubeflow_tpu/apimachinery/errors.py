@@ -45,6 +45,20 @@ class ForbiddenError(ApiError):
     reason = "Forbidden"
 
 
+class GoneError(ApiError):
+    """Watch resume window expired (HTTP 410): the requested resourceVersion
+    is older than the server's retained event history. Clients must re-list
+    and re-watch — the standard informer relist path."""
+
+    code = 410
+    reason = "Expired"
+
+
+class UnauthorizedError(ApiError):
+    code = 401
+    reason = "Unauthorized"
+
+
 class AdmissionDeniedError(ApiError):
     """A mutating/validating webhook rejected the request (failurePolicy: Fail)."""
 

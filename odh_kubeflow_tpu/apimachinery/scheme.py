@@ -30,6 +30,10 @@ class Scheme:
     def class_for(self, api_version: str, kind: str) -> Optional[Type[KubeObject]]:
         return self._by_gvk.get((api_version, kind))
 
+    def registrations(self) -> Dict[Tuple[str, str], Type[KubeObject]]:
+        """All registered (apiVersion, kind) pairs — discovery's data source."""
+        return dict(self._by_gvk)
+
     def gvk_for(self, cls: Type[KubeObject]) -> GroupVersionKind:
         for klass in cls.__mro__:
             if klass in self._by_cls:

@@ -17,6 +17,7 @@ faithful where the controllers depend on them:
 """
 from __future__ import annotations
 
+import collections
 import copy
 import itertools
 import json
@@ -30,6 +31,7 @@ from ..apimachinery import (
     AdmissionDeniedError,
     AlreadyExistsError,
     ConflictError,
+    GoneError,
     InvalidError,
     KubeObject,
     NotFoundError,
@@ -242,10 +244,24 @@ class Store:
     them in an in-process dict with the same canonical-JSON value semantics;
     `"auto"` (default) uses native when the library is loadable."""
 
-    def __init__(self, scheme: Scheme = default_scheme, backend: str = "auto"):
+    def __init__(
+        self,
+        scheme: Scheme = default_scheme,
+        backend: str = "auto",
+        watch_history_limit: int = 4096,
+    ):
         self.scheme = scheme
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
+        self._last_rv = 0
+        # Watch cache: per-storage-key retained (rv, event) history so watches
+        # can resume from a resourceVersion (kube-apiserver's watch cache is
+        # per-resource too — a busy kind must not evict a quiet kind's resume
+        # window). When a requested RV predates the retained window we answer
+        # 410 Gone and the client must relist — the informer relist contract.
+        self._watch_history_limit = watch_history_limit
+        self._history: Dict[Tuple[str, str], "collections.deque[Tuple[int, WatchEvent]]"] = {}
+        self._history_dropped_rv: Dict[Tuple[str, str], int] = {}
         self._native = None
         if backend not in ("auto", "native", "python"):
             raise ValueError(f"unknown store backend {backend!r}")
@@ -285,11 +301,31 @@ class Store:
 
     def _next_rv(self) -> str:
         if self._native is not None:
-            return str(self._native.next_rv())
-        return str(next(self._rv))
+            rv = self._native.next_rv()
+        else:
+            rv = next(self._rv)
+        self._last_rv = max(self._last_rv, int(rv))
+        return str(rv)
+
+    def current_rv(self) -> str:
+        """Most recently issued resourceVersion — the collection RV a LIST
+        response reports (listMeta.resourceVersion) and a watch resumes from."""
+        with self._lock:
+            return str(self._last_rv)
 
     def _emit(self, api_version: str, kind: str, ev: WatchEvent) -> None:
-        for q in self._watchers.get(self._storage_key(api_version, kind), []):
+        skey = self._storage_key(api_version, kind)
+        try:
+            rv = int(ev.object.get("metadata", {}).get("resourceVersion", "0"))
+        except ValueError:
+            rv = 0
+        hist = self._history.get(skey)
+        if hist is None:
+            hist = self._history[skey] = collections.deque(maxlen=self._watch_history_limit)
+        if hist.maxlen and len(hist) == hist.maxlen:
+            self._history_dropped_rv[skey] = hist[0][0]
+        hist.append((rv, ev))
+        for q in self._watchers.get(skey, []):
             q.put(ev)
 
     def _run_admission(self, req: AdmissionRequest) -> Dict[str, Any]:
@@ -383,6 +419,23 @@ class Store:
                     out.append(obj)
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
             return out
+
+    def list_raw_with_rv(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> Tuple[List[Dict[str, Any]], str]:
+        """List plus the collection resourceVersion, under ONE lock acquisition —
+        the atomic list-then-watch snapshot the transport's informer resume
+        depends on (an interleaved create would otherwise be invisible to both
+        the list and the `erv > rv` watch replay)."""
+        with self._lock:
+            return (
+                self.list_raw(api_version, kind, namespace=namespace, label_selector=label_selector),
+                str(self._last_rv),
+            )
 
     def update_raw(self, obj: Dict[str, Any], subresource: str = "") -> Dict[str, Any]:
         obj = copy.deepcopy(obj)
@@ -493,6 +546,9 @@ class Store:
 
     def _remove(self, api_version: str, kind: str, bucket: Any, key: str) -> None:
         obj = bucket.pop(key)
+        # the DELETED event carries a fresh RV (as kube-apiserver does) so
+        # watch resume from that RV does not replay the deletion
+        obj.setdefault("metadata", {})["resourceVersion"] = self._next_rv()
         self._emit(api_version, kind, WatchEvent(DELETED, obj))
         if self._gc_enabled:
             self._cascade_delete(obj)
@@ -525,12 +581,38 @@ class Store:
         kind: str,
         namespace: Optional[str] = None,
         send_initial: bool = True,
+        since_rv: Optional[str] = None,
     ) -> Watch:
         """Subscribe; atomically delivers synthetic ADDEDs for the current
-        state first (list+watch without a gap, which is what informers need)."""
+        state first (list+watch without a gap, which is what informers need).
+
+        With since_rv, instead replays retained history strictly after that
+        resourceVersion (the `?watch=true&resourceVersion=N` resume path);
+        raises GoneError when the window has been trimmed past it."""
         q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         skey = self._storage_key(api_version, kind)
         with self._lock:
+            pending: List[WatchEvent] = []
+            if since_rv is not None:
+                try:
+                    rv = int(since_rv)
+                except ValueError:
+                    raise GoneError(f"invalid resourceVersion {since_rv!r}")
+                if rv < self._history_dropped_rv.get(skey, 0):
+                    raise GoneError(f"too old resource version: {since_rv}")
+                pending = [
+                    ev for (erv, ev) in self._history.get(skey, ())
+                    if erv > rv
+                    and (
+                        namespace is None
+                        or ev.object.get("metadata", {}).get("namespace", "") == namespace
+                    )
+                ]
+            elif send_initial:
+                pending = [
+                    WatchEvent(ADDED, obj)
+                    for obj in self.list_raw(api_version, kind, namespace=namespace)
+                ]
             self._watchers.setdefault(skey, []).append(q)
 
             def cancel() -> None:
@@ -541,7 +623,5 @@ class Store:
                         pass
 
             w = Watch(q, cancel, namespace=namespace)
-            if send_initial:
-                for obj in self.list_raw(api_version, kind, namespace=namespace):
-                    w.pending.append(WatchEvent(ADDED, obj))
+            w.pending = pending
         return w

@@ -179,3 +179,85 @@ def test_sanitize_name_long():
     s = sanitize_name(long)
     assert len(s) <= 63
     assert s != sanitize_name("b" * 80)
+
+
+# ---- REST mapper + RFC 6902 JSON Patch (transport foundations) ----
+
+
+def test_rest_mapper_paths():
+    from odh_kubeflow_tpu.apimachinery import RESTMapper
+
+    m = RESTMapper()
+    nb = m.mapping_for("kubeflow.org/v1beta1", "Notebook")
+    assert nb.plural == "notebooks"
+    assert nb.path("user-ns", "my-nb") == (
+        "/apis/kubeflow.org/v1beta1/namespaces/user-ns/notebooks/my-nb"
+    )
+    assert nb.path("user-ns", "my-nb", "status").endswith("/my-nb/status")
+    cm = m.mapping_for("v1", "ConfigMap")
+    assert cm.path("ns") == "/api/v1/namespaces/ns/configmaps"
+    crb = m.mapping_for("rbac.authorization.k8s.io/v1", "ClusterRoleBinding")
+    assert not crb.namespaced
+    assert crb.path(namespace="ignored", name="x") == (
+        "/apis/rbac.authorization.k8s.io/v1/clusterrolebindings/x"
+    )
+    np = m.mapping_for("networking.k8s.io/v1", "NetworkPolicy")
+    assert np.plural == "networkpolicies"
+    assert m.kind_for("v1", "configmaps") == ("v1", "ConfigMap")
+
+
+def test_json_patch_apply_roundtrip():
+    from odh_kubeflow_tpu.apimachinery import json_patch_apply, json_patch_diff
+
+    old = {
+        "metadata": {"name": "nb", "annotations": {"a": "1", "drop": "x"}},
+        "spec": {"containers": [{"name": "c", "image": "i:1"}], "extra": True},
+    }
+    new = {
+        "metadata": {"name": "nb", "annotations": {"a": "2", "added": "y"}},
+        "spec": {"containers": [{"name": "c", "image": "i:2"}, {"name": "s"}]},
+    }
+    ops = json_patch_diff(old, new)
+    assert json_patch_apply(old, ops) == new
+    # no-op diff is empty
+    assert json_patch_diff(new, new) == []
+
+
+def test_json_patch_pointer_escaping():
+    from odh_kubeflow_tpu.apimachinery import json_patch_apply, json_patch_diff
+
+    old = {"metadata": {"annotations": {}}}
+    new = {"metadata": {"annotations": {"kubeflow.org/last-activity": "t", "a/b~c": "v"}}}
+    ops = json_patch_diff(old, new)
+    assert json_patch_apply(old, ops) == new
+
+
+def test_json_patch_ops():
+    from odh_kubeflow_tpu.apimachinery import json_patch_apply
+
+    doc = {"a": [1, 2], "b": {"c": 1}}
+    out = json_patch_apply(
+        doc,
+        [
+            {"op": "add", "path": "/a/-", "value": 3},
+            {"op": "test", "path": "/b/c", "value": 1},
+            {"op": "move", "from": "/b/c", "path": "/d"},
+            {"op": "copy", "from": "/a/0", "path": "/e"},
+            {"op": "remove", "path": "/a/1"},
+            {"op": "replace", "path": "/e", "value": 9},
+        ],
+    )
+    assert out == {"a": [1, 3], "b": {}, "d": 1, "e": 9}
+
+
+def test_rest_mapper_populate_from_scheme():
+    from odh_kubeflow_tpu.apimachinery import RESTMapper, default_scheme
+    import odh_kubeflow_tpu.api  # noqa: F401 — triggers registrations
+
+    m = RESTMapper()
+    m.populate_from_scheme(default_scheme)
+    assert m.kind_for("kubeflow.org/v1beta1", "notebooks") == (
+        "kubeflow.org/v1beta1",
+        "Notebook",
+    )
+    assert m.kind_for("apps/v1", "statefulsets") == ("apps/v1", "StatefulSet")
