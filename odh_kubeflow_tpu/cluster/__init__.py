@@ -9,7 +9,10 @@ from .store import (
     WatchEvent,
     register_storage_alias,
 )
+from .apiserver import ApiServer, parse_label_selector
 from .kubelet import Behavior, Kubelet, PodDecision
+from .remote import RemoteStore, RemoteWatch
+from .webhook_dispatch import WebhookDispatcher
 from .scheduler import Scheduler
 from .sim import SimCluster
 from .statefulset import StatefulSetController

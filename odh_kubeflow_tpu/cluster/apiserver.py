@@ -1,0 +1,459 @@
+"""HTTP API server: the Kubernetes wire protocol over the Store.
+
+The reference's managers talk to a real kube-apiserver over HTTPS
+(reference components/notebook-controller/main.go:79-94 ctrl.GetConfigOrDie;
+odh main.go:117-245). This module is the other half of that seam for the TPU
+build: it serves the standard Kubernetes REST protocol — resource paths,
+verbs, Status errors, label selectors, the status subresource, merge patch,
+and streaming `?watch=true` with resourceVersion resume — on top of the
+Store. The RemoteStore client (cluster/remote.py) speaks exactly this
+protocol, so the same client works against a real kube-apiserver; and this
+server doubles as the envtest-style fixture (reference odh
+controllers/suite_test.go:91-275 boots kube-apiserver+etcd for tests; here
+the suite boots ApiServer over a Store).
+
+Wire compatibility notes:
+- paths: /api/v1/... (legacy core group) and /apis/{group}/{version}/...,
+  with /namespaces/{ns}/ for namespaced resources and bare collection paths
+  for cluster scope / all-namespaces lists,
+- GET collection -> {kind}List with listMeta.resourceVersion (atomic with the
+  item snapshot), GET ?watch=true -> chunked JSON-lines stream of
+  {"type","object"} events; resourceVersion=N resumes strictly after N and
+  answers 410 Expired past the retained window,
+- POST/PUT/DELETE with Status error bodies; PATCH accepts both
+  application/merge-patch+json (RFC 7386) and application/json-patch+json
+  (RFC 6902),
+- PUT .../status hits the status subresource,
+- authentication: static bearer token (ServiceAccount-token analog), TLS via
+  certfile/keyfile.
+"""
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from ..apimachinery import (
+    ApiError,
+    GoneError,
+    InvalidError,
+    NotFoundError,
+    RESTMapper,
+    Scheme,
+    UnauthorizedError,
+    default_scheme,
+    json_patch_apply,
+    match_labels,
+)
+from .store import Store, Watch
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    # a manager opens one streaming watch per informed kind at startup —
+    # the stdlib listen backlog of 5 is too small for that burst
+    request_queue_size = 128
+
+# admission callout hook: (operation, object, old_object) -> mutated object.
+# Task of the webhook dispatcher (webhook/dispatch.py); None = store-only
+# admission (whatever handlers are registered in-process on the Store).
+AdmissionCallout = Callable[[str, Dict[str, Any], Optional[Dict[str, Any]]], Dict[str, Any]]
+
+
+class _Route:
+    __slots__ = ("api_version", "kind", "namespace", "name", "subresource", "namespaced")
+
+    def __init__(self, api_version, kind, namespace, name, subresource, namespaced):
+        self.api_version = api_version
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+        self.namespaced = namespaced
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    return json.dumps(
+        {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "metadata": {},
+            "status": "Failure",
+            "message": message,
+            "reason": reason,
+            "code": code,
+        }
+    ).encode()
+
+
+def parse_label_selector(raw: str) -> Optional[Dict[str, str]]:
+    """`k=v,k2=v2` (also `k==v`) -> dict; empty -> None."""
+    if not raw:
+        return None
+    out: Dict[str, str] = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "==" in part:
+            k, v = part.split("==", 1)
+        elif "=" in part:
+            k, v = part.split("=", 1)
+        else:
+            raise InvalidError(f"unsupported label selector {part!r}")
+        out[k.strip()] = v.strip()
+    return out or None
+
+
+class ApiServer:
+    """Serve a Store over the Kubernetes REST protocol."""
+
+    def __init__(
+        self,
+        store: Store,
+        scheme: Scheme = default_scheme,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        bearer_token: Optional[str] = None,
+        certfile: Optional[str] = None,
+        keyfile: Optional[str] = None,
+        admission: Optional[AdmissionCallout] = None,
+    ):
+        self.store = store
+        self.scheme = scheme
+        self.mapper = RESTMapper()
+        self.mapper.populate_from_scheme(scheme)
+        self.bearer_token = bearer_token
+        self.admission = admission
+        self._stopping = threading.Event()
+        self._active_watches: List[Watch] = []
+        self._watch_lock = threading.Lock()
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def do_GET(self):
+                server._dispatch(self, "GET")
+
+            def do_POST(self):
+                server._dispatch(self, "POST")
+
+            def do_PUT(self):
+                server._dispatch(self, "PUT")
+
+            def do_PATCH(self):
+                server._dispatch(self, "PATCH")
+
+            def do_DELETE(self):
+                server._dispatch(self, "DELETE")
+
+        self.httpd = _HTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.tls = bool(certfile)
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def base_url(self) -> str:
+        host, port = self.address
+        return f"{'https' if self.tls else 'http'}://{host}:{port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="apiserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()
+        with self._watch_lock:
+            for w in self._active_watches:
+                w.stop()
+            self._active_watches.clear()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- request plumbing --
+
+    def _dispatch(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        try:
+            if not self._authorized(h):
+                raise UnauthorizedError("missing or invalid bearer token")
+            parsed = urlparse(h.path)
+            query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+            route = self._parse_path(parsed.path)
+            if route is None:
+                raise NotFoundError(f"the server could not find the requested resource {parsed.path!r}")
+            if method == "GET":
+                if route.name:
+                    self._get(h, route)
+                elif query.get("watch") in ("true", "1"):
+                    self._watch(h, route, query)
+                else:
+                    self._list(h, route, query)
+            elif method == "POST" and not route.name:
+                self._create(h, route)
+            elif method == "PUT" and route.name:
+                self._update(h, route)
+            elif method == "PATCH" and route.name:
+                self._patch(h, route)
+            elif method == "DELETE" and route.name:
+                self._delete(h, route)
+            else:
+                raise InvalidError(f"unsupported {method} on {parsed.path!r}")
+        except ApiError as e:
+            self._send_status_error(h, e)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # never leak a stack trace into the connection
+            err = ApiError(f"internal error: {e!r}")
+            try:
+                self._send_status_error(h, err)
+            except OSError:
+                pass
+
+    def _authorized(self, h: BaseHTTPRequestHandler) -> bool:
+        if self.bearer_token is None:
+            return True
+        auth = h.headers.get("Authorization", "")
+        return auth == f"Bearer {self.bearer_token}"
+
+    def _parse_path(self, path: str) -> Optional[_Route]:
+        parts = [unquote(p) for p in path.strip("/").split("/") if p]
+        if not parts:
+            return None
+        if parts[0] == "api":
+            if len(parts) < 2 or parts[1] != "v1":
+                return None
+            api_version, rest = "v1", parts[2:]
+        elif parts[0] == "apis":
+            if len(parts) < 3:
+                return None
+            api_version, rest = f"{parts[1]}/{parts[2]}", parts[3:]
+        else:
+            return None
+        namespace = ""
+        namespaced_path = False
+        if len(rest) >= 2 and rest[0] == "namespaces":
+            # /namespaces/{ns}/{plural}/... — but bare /api/v1/namespaces[/name]
+            # is the Namespace resource itself
+            if len(rest) >= 3:
+                namespace, rest = rest[1], rest[2:]
+                namespaced_path = True
+        if not rest:
+            return None
+        plural, rest = rest[0], rest[1:]
+        gvk = self.mapper.kind_for(api_version, plural)
+        if gvk is None:
+            return None
+        _, kind = gvk
+        name = rest[0] if rest else ""
+        subresource = rest[1] if len(rest) > 1 else ""
+        if len(rest) > 2:
+            return None
+        return _Route(api_version, kind, namespace, name, subresource, namespaced_path)
+
+    def _read_body(self, h: BaseHTTPRequestHandler) -> Dict[str, Any]:
+        length = int(h.headers.get("Content-Length", "0"))
+        raw = h.rfile.read(length) if length else b""
+        if not raw:
+            raise InvalidError("request body required")
+        try:
+            body = json.loads(raw)
+        except ValueError as e:
+            raise InvalidError(f"invalid JSON body: {e}")
+        if not isinstance(body, (dict, list)):
+            raise InvalidError("JSON body must be an object")
+        return body
+
+    def _send_json(self, h: BaseHTTPRequestHandler, code: int, obj: Dict[str, Any]) -> None:
+        body = json.dumps(obj).encode()
+        h.send_response(code)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _send_status_error(self, h: BaseHTTPRequestHandler, e: ApiError) -> None:
+        body = _status_body(e.code, e.reason, str(e))
+        h.send_response(e.code)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    # -- verbs --
+
+    def _admit(
+        self, operation: str, obj: Dict[str, Any], old: Optional[Dict[str, Any]]
+    ) -> Dict[str, Any]:
+        if self.admission is not None:
+            return self.admission(operation, obj, old)
+        return obj
+
+    def _get(self, h, route: _Route) -> None:
+        obj = self.store.get_raw(route.api_version, route.kind, route.namespace, route.name)
+        self._send_json(h, 200, obj)
+
+    def _list(self, h, route: _Route, query: Dict[str, str]) -> None:
+        selector = parse_label_selector(query.get("labelSelector", ""))
+        items, rv = self.store.list_raw_with_rv(
+            route.api_version,
+            route.kind,
+            namespace=route.namespace if route.namespaced else None,
+            label_selector=selector,
+        )
+        self._send_json(
+            h,
+            200,
+            {
+                "apiVersion": route.api_version,
+                "kind": f"{route.kind}List",
+                "metadata": {"resourceVersion": rv},
+                "items": items,
+            },
+        )
+
+    def _create(self, h, route: _Route) -> None:
+        obj = self._read_body(h)
+        meta = obj.setdefault("metadata", {})
+        if route.namespaced:
+            meta["namespace"] = route.namespace
+        obj.setdefault("apiVersion", route.api_version)
+        obj.setdefault("kind", route.kind)
+        obj = self._admit("CREATE", obj, None)
+        out = self.store.create_raw(obj)
+        self._send_json(h, 201, out)
+
+    def _update(self, h, route: _Route) -> None:
+        obj = self._read_body(h)
+        if route.subresource not in ("", "status"):
+            raise InvalidError(f"unsupported subresource {route.subresource!r}")
+        if route.subresource != "status":
+            try:
+                old = self.store.get_raw(
+                    route.api_version, route.kind, route.namespace, route.name
+                )
+            except NotFoundError:
+                old = None
+            obj = self._admit("UPDATE", obj, old)
+        out = self.store.update_raw(obj, subresource=route.subresource)
+        self._send_json(h, 200, out)
+
+    def _patch(self, h, route: _Route) -> None:
+        patch = self._read_body(h)
+        ctype = h.headers.get("Content-Type", "application/merge-patch+json")
+        if route.subresource not in ("", "status"):
+            raise InvalidError(f"unsupported subresource {route.subresource!r}")
+        if "json-patch" in ctype:
+            if not isinstance(patch, list):
+                raise InvalidError("json-patch body must be an op list")
+            current = self.store.get_raw(
+                route.api_version, route.kind, route.namespace, route.name
+            )
+            patched = json_patch_apply(current, patch)
+            patched.setdefault("metadata", {})["resourceVersion"] = current["metadata"][
+                "resourceVersion"
+            ]
+            if route.subresource != "status":
+                patched = self._admit("UPDATE", patched, current)
+            out = self.store.update_raw(patched, subresource=route.subresource)
+        else:
+            if not isinstance(patch, dict):
+                raise InvalidError("merge-patch body must be an object")
+            if self.admission is not None and route.subresource != "status":
+                from ..apimachinery import json_merge_patch
+
+                current = self.store.get_raw(
+                    route.api_version, route.kind, route.namespace, route.name
+                )
+                patched = json_merge_patch(current, patch)
+                patched = self._admit("UPDATE", patched, current)
+                patched.setdefault("metadata", {})["resourceVersion"] = current[
+                    "metadata"
+                ]["resourceVersion"]
+                out = self.store.update_raw(patched, subresource=route.subresource)
+            else:
+                out = self.store.patch_raw(
+                    route.api_version,
+                    route.kind,
+                    route.namespace,
+                    route.name,
+                    patch,
+                    subresource=route.subresource,
+                )
+        self._send_json(h, 200, out)
+
+    def _delete(self, h, route: _Route) -> None:
+        self.store.delete_raw(route.api_version, route.kind, route.namespace, route.name)
+        self._send_json(
+            h, 200, {"kind": "Status", "apiVersion": "v1", "status": "Success"}
+        )
+
+    # -- watch streaming --
+
+    def _watch(self, h, route: _Route, query: Dict[str, str]) -> None:
+        since_rv = query.get("resourceVersion") or None
+        selector = parse_label_selector(query.get("labelSelector", ""))
+        w = self.store.watch(
+            route.api_version,
+            route.kind,
+            namespace=route.namespace if route.namespaced else None,
+            send_initial=since_rv is None,
+            since_rv=since_rv,
+        )
+        with self._watch_lock:
+            self._active_watches.append(w)
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+
+            def send_chunk(payload: bytes) -> None:
+                h.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
+                h.wfile.flush()
+
+            while not self._stopping.is_set():
+                ev = w.get(timeout=0.5)
+                if ev is None:
+                    if self._stopping.is_set() or w.stopped:
+                        break  # server shutdown or stream severed: end cleanly
+                    continue
+                if selector is not None and not match_labels(
+                    selector, ev.object.get("metadata", {}).get("labels")
+                ):
+                    continue
+                line = json.dumps({"type": ev.type, "object": ev.object}) + "\n"
+                send_chunk(line.encode())
+            try:
+                h.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            w.stop()
+            with self._watch_lock:
+                try:
+                    self._active_watches.remove(w)
+                except ValueError:
+                    pass
+            h.close_connection = True

@@ -97,6 +97,7 @@ class Watch:
         self._q = q
         self._cancel = cancel
         self._namespace = namespace
+        self.stopped = False
         self.pending: List[WatchEvent] = []  # initial-list synthetic ADDEDs
 
     def _admit(self, ev: Optional[WatchEvent]) -> bool:
@@ -116,6 +117,7 @@ class Watch:
                 return ev
 
     def stop(self) -> None:
+        self.stopped = True
         self._cancel()
         self._q.put(None)
 

@@ -31,7 +31,14 @@ def build_manager(
     leader_election: bool = False,
     http_get=None,
 ) -> Manager:
-    """Everything the two reference managers run, on one Manager."""
+    """Everything the two reference managers run, on one Manager.
+
+    `store` is either the in-process Store (sim / single-binary mode: the
+    webhook registers straight into its admission chain) or a RemoteStore
+    speaking to an API server over the wire — in that mode admission runs
+    server-side via MutatingWebhookConfiguration + the HTTPS webhook server
+    (runtime/webhook_server.py; see serve_webhook), exactly the reference's
+    deployment shape (odh main.go:213-227)."""
     config = config or Config.from_env()
     mgr = Manager(
         store,
@@ -40,7 +47,8 @@ def build_manager(
     )
     metrics = NotebookMetrics(mgr.metrics, mgr.client)
 
-    NotebookWebhook(mgr.client, config).register(store)
+    if hasattr(store, "register_webhook"):
+        NotebookWebhook(mgr.client, config).register(store)
     NotebookReconciler(mgr, config, metrics=metrics).setup()
     EventMirrorController(mgr).setup()
     TPUWorkbenchReconciler(mgr, config).setup()
@@ -48,15 +56,63 @@ def build_manager(
     return mgr
 
 
-def main() -> None:  # pragma: no cover - thin CLI shell
-    logging.basicConfig(level=logging.INFO)
-    from .cluster.sim import SimCluster
+def serve_webhook(client, config: Config, cert_dir: str, port: int = 8443):
+    """Serve the mutating webhook over HTTPS from a cert dir (tls.crt/tls.key,
+    the kubernetes.io/tls Secret layout) — the remote-mode admission path."""
+    import os
 
+    from .runtime.webhook_server import WebhookServer
+
+    server = WebhookServer(
+        host="0.0.0.0",
+        port=port,
+        certfile=os.path.join(cert_dir, "tls.crt"),
+        keyfile=os.path.join(cert_dir, "tls.key"),
+    )
+    server.register("/mutate-notebook-v1", NotebookWebhook(client, config).handle)
+    return server.start()
+
+
+def main() -> None:  # pragma: no cover - thin CLI shell
+    """Entrypoint. Two modes, chosen by KUBECONFIG (ctrl.GetConfigOrDie analog):
+
+    - KUBECONFIG set (the deployed shape): connect to the API server over the
+      wire, serve the mutating webhook over HTTPS from WEBHOOK_CERT_DIR, and
+      run all controllers against the real cluster.
+    - otherwise: boot the in-process SimCluster (the dev/demo shape).
+    """
+    import os
+
+    logging.basicConfig(level=logging.INFO)
     config = Config.from_env()
-    cluster = SimCluster().start()
-    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    cluster = None
+    webhook_server = None
+    # explicit opt-in only: a merely-existing ~/.kube/config must never flip a
+    # demo run into mutating whatever cluster current-context points at
+    if os.environ.get("KUBECONFIG"):
+        from .cluster.remote import RemoteStore
+
+        store = RemoteStore.from_kubeconfig()
+        cert_dir = os.environ.get("WEBHOOK_CERT_DIR", "/tmp/k8s-webhook-server/serving-certs")
+        if os.path.exists(os.path.join(cert_dir, "tls.crt")):
+            from .cluster.client import Client
+
+            webhook_server = serve_webhook(
+                Client(store),
+                config,
+                cert_dir,
+                port=int(os.environ.get("WEBHOOK_PORT", "8443")),
+            )
+            log.info("mutating webhook serving on :%s", webhook_server.httpd.server_address[1])
+        mgr = build_manager(store, config, leader_election=True)
+        log.info("tpu-notebook-controller running (kubeconfig: %s)", store.base_url)
+    else:
+        from .cluster.sim import SimCluster
+
+        cluster = SimCluster().start()
+        mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+        log.info("tpu-notebook-controller running (in-process cluster)")
     mgr.start()
-    log.info("tpu-notebook-controller running (in-process cluster)")
     try:
         import signal
         import threading
@@ -67,7 +123,10 @@ def main() -> None:  # pragma: no cover - thin CLI shell
         stop.wait()
     finally:
         mgr.stop()
-        cluster.stop()
+        if webhook_server is not None:
+            webhook_server.stop()
+        if cluster is not None:
+            cluster.stop()
 
 
 if __name__ == "__main__":

@@ -21,7 +21,6 @@ from ..apimachinery import (
 )
 from ..cluster.client import Client
 from ..cluster.store import Store
-from .builder import Builder
 from .controller import Controller
 from .informer import InformerRegistry
 from .metrics import Registry, global_registry
@@ -146,7 +145,12 @@ class Manager:
         if leader_election:
             self.elector = LeaderElector(self.client, leader_election_id)
 
-    def builder(self, name: str) -> Builder:
+    def builder(self, name: str) -> "Builder":
+        # deferred: builder imports cluster.store, whose package init reaches
+        # back into runtime.manager via the kubelet — a module-level import
+        # here would make `import odh_kubeflow_tpu.runtime` order-dependent
+        from .builder import Builder
+
         return Builder(self, name)
 
     def add_controller(self, ctrl: Controller) -> None:

@@ -1,0 +1,216 @@
+"""Remote-transport e2e: the FULL operator against the cluster OVER THE WIRE.
+
+The VERDICT-r1 acceptance test for the real-cluster adapter: `build_manager`
+runs unchanged on a RemoteStore, every informer watch is a streaming HTTP
+connection, every reconcile write is a REST call, and admission happens
+server-side via MutatingWebhookConfiguration -> HTTPS AdmissionReview callout
+to the real NotebookWebhook. The cluster side (scheduler, kubelet, probe
+agents) is the SimCluster acting on the same Store the ApiServer serves —
+i.e. the manager process has NO in-process access to cluster state.
+
+Reference anchors: managers connect via ctrl.GetConfigOrDie
+(notebook-controller/main.go:79-94); webhook served over TLS
+(odh main.go:213-227, suite_test.go:120-246).
+"""
+import base64
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.admission import (
+    MutatingWebhook,
+    MutatingWebhookConfiguration,
+    RuleWithOperations,
+    WebhookClientConfig,
+)
+from odh_kubeflow_tpu.api.apps import StatefulSet
+from odh_kubeflow_tpu.api.core import Container, Service
+from odh_kubeflow_tpu.api.gateway import HTTPRoute
+from odh_kubeflow_tpu.api.networking import NetworkPolicy
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.apimachinery import NotFoundError
+from odh_kubeflow_tpu.cluster import (
+    ApiServer,
+    Client,
+    RemoteStore,
+    SimCluster,
+    WebhookDispatcher,
+)
+from odh_kubeflow_tpu.controllers import Config, NotebookWebhook
+from odh_kubeflow_tpu.controllers import constants as C
+from odh_kubeflow_tpu.main import build_manager
+from odh_kubeflow_tpu.probe import sim_agent_behavior
+from odh_kubeflow_tpu.runtime.webhook_server import WebhookServer
+from odh_kubeflow_tpu.utils.certs import generate_cert_dir
+
+CTRL_NS = "tpu-notebooks-system"
+NS = "remote-user"
+TIMEOUT = 30
+
+
+def wait_for(fn, timeout=TIMEOUT, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+        except NotFoundError:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def gone(fn, timeout=TIMEOUT, msg="gone"):
+    def check():
+        try:
+            fn()
+            return False
+        except NotFoundError:
+            return True
+
+    return wait_for(check, timeout=timeout, msg=msg)
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    # ---- cluster side: sim nodes/kubelet/agents + the API server over TLS
+    cluster = SimCluster().start()
+    cluster.add_cpu_pool("cpu", nodes=2)
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=4)
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.8))
+
+    pki = tmp_path_factory.mktemp("remote-pki")
+    ca, crt, key = generate_cert_dir(str(pki))
+    with open(ca, "rb") as f:
+        ca_b64 = base64.b64encode(f.read()).decode()
+
+    api = ApiServer(
+        cluster.store,
+        bearer_token="e2e-token",
+        certfile=crt,
+        keyfile=key,
+        admission=WebhookDispatcher(cluster.store),
+    ).start()
+
+    config = Config(
+        controller_namespace=CTRL_NS,
+        enable_culling=True,
+        cull_idle_time_min=2.0 / 60.0,
+        idleness_check_period_min=0.1 / 60.0,
+        set_pipeline_rbac=True,
+    )
+
+    # ---- manager side: everything over the wire from here on
+    remote = RemoteStore(
+        api.base_url, token="e2e-token", ca_file=ca, timeout=10
+    )
+    webhook_server = WebhookServer(certfile=crt, keyfile=key).start()
+    webhook_server.register(
+        "/mutate-notebook-v1", NotebookWebhook(Client(remote), config).handle
+    )
+    cfg = MutatingWebhookConfiguration()
+    cfg.metadata.name = "notebook-mutator"
+    cfg.webhooks = [
+        MutatingWebhook(
+            name="notebooks.kubeflow.org",
+            client_config=WebhookClientConfig(
+                url=f"{webhook_server.base_url}/mutate-notebook-v1", ca_bundle=ca_b64
+            ),
+            rules=[
+                RuleWithOperations(
+                    operations=["CREATE", "UPDATE"],
+                    api_groups=["kubeflow.org"],
+                    api_versions=["*"],
+                    resources=["notebooks"],
+                )
+            ],
+        )
+    ]
+    Client(remote).create(cfg)
+
+    mgr = build_manager(remote, config, http_get=cluster.http_get)
+    mgr.start()
+    client = Client(remote)
+    yield cluster, client, agents
+    mgr.stop()
+    webhook_server.stop()
+    api.stop()
+    cluster.stop()
+
+
+def mk_nb(name, annotations=None):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = NS
+    nb.metadata.annotations = dict(annotations or {})
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    nb.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+    return nb
+
+
+def test_notebook_lifecycle_over_the_wire(ctx):
+    cluster, client, agents = ctx
+    client.create(mk_nb("wire"))
+
+    # webhook ran over HTTPS: the stored object carries the lock... and the
+    # extension controller (also over the wire) later removes it
+    sts = wait_for(lambda: client.get(StatefulSet, NS, "wire"), msg="sts")
+    assert sts.spec.template.spec.node_selector.get("cloud.google.com/gke-tpu-accelerator")
+    wait_for(lambda: client.get(Service, NS, "wire"), msg="svc")
+    wait_for(
+        lambda: [r for r in client.list(HTTPRoute, namespace=CTRL_NS)
+                 if r.metadata.labels.get("notebook-name") == "wire"],
+        msg="route",
+    )
+    wait_for(lambda: client.get(NetworkPolicy, NS, "wire-ctrl-np"), msg="netpol")
+    nb = wait_for(
+        lambda: client.get(Notebook, NS, "wire").status.ready_replicas == 1
+        and client.get(Notebook, NS, "wire"),
+        msg="ready",
+    )
+    assert C.STOP_ANNOTATION not in nb.metadata.annotations
+
+
+def test_culling_and_wakeup_over_the_wire(ctx):
+    cluster, client, agents = ctx
+    client.create(mk_nb("dozy"))
+    wait_for(
+        lambda: client.get(Notebook, NS, "dozy").status.ready_replicas == 1,
+        msg="ready",
+    )
+    # make the workload idle: stale kernels AND zero TPU duty-cycle
+    agent = agents["dozy-0"]
+    agent.kernels.set_idle(time.time() - 3600)
+    agent.monitor.duty = 0.0
+    nb = wait_for(
+        lambda: C.STOP_ANNOTATION
+        in client.get(Notebook, NS, "dozy").metadata.annotations
+        and client.get(Notebook, NS, "dozy"),
+        msg="culled",
+    )
+    assert nb.metadata.annotations[C.STOP_ANNOTATION] != C.RECONCILIATION_LOCK_VALUE
+    wait_for(
+        lambda: client.get(StatefulSet, NS, "dozy").spec.replicas == 0,
+        msg="scaled to zero",
+    )
+
+
+def test_deletion_cleanup_over_the_wire(ctx):
+    cluster, client, agents = ctx
+    client.create(mk_nb("doomed"))
+    wait_for(lambda: client.get(StatefulSet, NS, "doomed"), msg="sts")
+    wait_for(
+        lambda: [r for r in client.list(HTTPRoute, namespace=CTRL_NS)
+                 if r.metadata.labels.get("notebook-name") == "doomed"],
+        msg="route",
+    )
+    client.delete(Notebook, NS, "doomed")
+    gone(lambda: client.get(Notebook, NS, "doomed"), msg="nb gone")
+    gone(lambda: client.get(StatefulSet, NS, "doomed"), msg="sts gone")
+    wait_for(
+        lambda: not [r for r in client.list(HTTPRoute, namespace=CTRL_NS)
+                     if r.metadata.labels.get("notebook-name") == "doomed"],
+        msg="route gone",
+    )

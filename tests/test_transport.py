@@ -1,0 +1,349 @@
+"""Transport tier: the Kubernetes wire protocol over real sockets.
+
+ApiServer (cluster/apiserver.py) serves a Store; RemoteStore
+(cluster/remote.py) is the client. Together they are the build's
+envtest: the same client bootstrap (kubeconfig, bearer token, TLS) works
+against a real kube-apiserver, and the suite proves the protocol pieces the
+controllers depend on — CRUD, conflicts, subresources, selectors, watch
+streams with RV resume and 410 relist — over an actual HTTP connection.
+Reference anchors: notebook-controller/main.go:79-94 (GetConfigOrDie),
+odh controllers/suite_test.go:91-275 (envtest fixture).
+"""
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.core import ConfigMap
+from odh_kubeflow_tpu.api.notebook import Notebook
+from odh_kubeflow_tpu.apimachinery import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    UnauthorizedError,
+)
+from odh_kubeflow_tpu.cluster import ApiServer, Client, RemoteStore, Store
+from odh_kubeflow_tpu.cluster.store import ADDED, DELETED, MODIFIED
+
+
+@pytest.fixture()
+def served():
+    store = Store()
+    server = ApiServer(store).start()
+    remote = RemoteStore(server.base_url, timeout=5)
+    yield store, server, remote
+    server.stop()
+
+
+def cm(name, ns="default", data=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": name, "namespace": ns},
+        "data": data or {},
+    }
+
+
+def test_crud_roundtrip_over_http(served):
+    _, _, remote = served
+    created = remote.create_raw(cm("alpha", data={"k": "v"}))
+    assert created["metadata"]["resourceVersion"]
+    got = remote.get_raw("v1", "ConfigMap", "default", "alpha")
+    assert got["data"] == {"k": "v"}
+    got["data"]["k"] = "v2"
+    updated = remote.update_raw(got)
+    assert updated["data"]["k"] == "v2"
+    assert int(updated["metadata"]["resourceVersion"]) > int(
+        created["metadata"]["resourceVersion"]
+    )
+    remote.delete_raw("v1", "ConfigMap", "default", "alpha")
+    with pytest.raises(NotFoundError):
+        remote.get_raw("v1", "ConfigMap", "default", "alpha")
+
+
+def test_error_mapping(served):
+    _, _, remote = served
+    remote.create_raw(cm("dup"))
+    with pytest.raises(AlreadyExistsError):
+        remote.create_raw(cm("dup"))
+    with pytest.raises(NotFoundError):
+        remote.get_raw("v1", "ConfigMap", "default", "ghost")
+    # stale-RV update maps to ConflictError across the wire
+    stale = remote.get_raw("v1", "ConfigMap", "default", "dup")
+    fresh = remote.get_raw("v1", "ConfigMap", "default", "dup")
+    fresh["data"] = {"x": "1"}
+    remote.update_raw(fresh)
+    stale["data"] = {"y": "2"}
+    with pytest.raises(ConflictError):
+        remote.update_raw(stale)
+
+
+def test_typed_client_over_remote_store(served):
+    """The controller-facing Client works unchanged on the remote backend."""
+    _, _, remote = served
+    client = Client(remote)
+    nb = Notebook()
+    nb.metadata.name = "wire-nb"
+    nb.metadata.namespace = "user"
+    nb.spec.template.spec.containers = [{"name": "c", "image": "jax:1"}]
+    client.create(nb)
+    got = client.get(Notebook, "user", "wire-nb")
+    assert got.metadata.uid
+    got.metadata.annotations["touched"] = "yes"
+    client.update(got)
+    assert client.get(Notebook, "user", "wire-nb").metadata.annotations["touched"] == "yes"
+
+
+def test_label_selector_and_all_namespace_list(served):
+    _, _, remote = served
+    remote.create_raw(cm("a", ns="one", data={}) | {})
+    obj = cm("b", ns="two")
+    obj["metadata"]["labels"] = {"app": "nb"}
+    remote.create_raw(obj)
+    all_items, rv = remote.list_raw_with_rv("v1", "ConfigMap")
+    assert {o["metadata"]["name"] for o in all_items} == {"a", "b"}
+    assert rv
+    only_two = remote.list_raw("v1", "ConfigMap", namespace="two")
+    assert [o["metadata"]["name"] for o in only_two] == ["b"]
+    labeled = remote.list_raw("v1", "ConfigMap", label_selector={"app": "nb"})
+    assert [o["metadata"]["name"] for o in labeled] == ["b"]
+
+
+def test_status_subresource_over_http(served):
+    _, _, remote = served
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "u"},
+        "spec": {"template": {"spec": {"containers": []}}},
+    }
+    remote.create_raw(nb)
+    cur = remote.get_raw("kubeflow.org/v1beta1", "Notebook", "u", "nb")
+    cur["status"] = {"readyReplicas": 3}
+    remote.update_raw(cur, subresource="status")
+    got = remote.get_raw("kubeflow.org/v1beta1", "Notebook", "u", "nb")
+    assert got["status"]["readyReplicas"] == 3
+    # plain update cannot clobber status (subresource isolation over the wire)
+    got["status"] = {"readyReplicas": 0}
+    remote.update_raw(got)
+    assert (
+        remote.get_raw("kubeflow.org/v1beta1", "Notebook", "u", "nb")["status"][
+            "readyReplicas"
+        ]
+        == 3
+    )
+
+
+def test_merge_patch_over_http(served):
+    _, _, remote = served
+    remote.create_raw(cm("p", data={"keep": "1", "drop": "2"}))
+    out = remote.patch_raw(
+        "v1", "ConfigMap", "default", "p", {"data": {"drop": None, "new": "3"}}
+    )
+    assert out["data"] == {"keep": "1", "new": "3"}
+
+
+def test_json_patch_content_type(served):
+    """RFC 6902 patches (the AdmissionReview patch format) are applied too."""
+    import json
+    import urllib.request
+
+    _, server, remote = served
+    remote.create_raw(cm("jp", data={"a": "1"}))
+    ops = [{"op": "replace", "path": "/data/a", "value": "9"}]
+    req = urllib.request.Request(
+        server.base_url + "/api/v1/namespaces/default/configmaps/jp",
+        data=json.dumps(ops).encode(),
+        method="PATCH",
+        headers={"Content-Type": "application/json-patch+json"},
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        out = json.loads(resp.read())
+    assert out["data"]["a"] == "9"
+
+
+def test_watch_stream_live_events(served):
+    _, _, remote = served
+    w = remote.watch("v1", "ConfigMap", namespace="default")
+    assert w.pending == []
+    remote.create_raw(cm("w1"))
+    ev = w.get(timeout=5)
+    assert ev.type == ADDED and ev.object["metadata"]["name"] == "w1"
+    remote.patch_raw("v1", "ConfigMap", "default", "w1", {"data": {"x": "1"}})
+    ev = w.get(timeout=5)
+    assert ev.type == MODIFIED
+    remote.delete_raw("v1", "ConfigMap", "default", "w1")
+    ev = w.get(timeout=5)
+    assert ev.type == DELETED
+    w.stop()
+
+
+def test_watch_initial_snapshot_then_live(served):
+    _, _, remote = served
+    remote.create_raw(cm("pre"))
+    w = remote.watch("v1", "ConfigMap", namespace="default")
+    first = w.get(timeout=5)  # synthetic ADDED from the list snapshot
+    assert first.type == ADDED and first.object["metadata"]["name"] == "pre"
+    remote.create_raw(cm("post"))
+    ev = w.get(timeout=5)
+    assert ev.object["metadata"]["name"] == "post"
+    w.stop()
+
+
+def test_watch_survives_connection_drop(served):
+    """Reflector contract: a dropped stream reconnects from the last seen RV
+    with no events lost and no duplicates."""
+    store, server, remote = served
+    w = remote.watch("v1", "ConfigMap", namespace="default")
+    remote.create_raw(cm("before-drop"))
+    assert w.get(timeout=5).object["metadata"]["name"] == "before-drop"
+    # sever every server-side watch stream (the server keeps running)
+    with server._watch_lock:
+        for sw in list(server._active_watches):
+            sw.stop()
+    time.sleep(0.1)
+    remote.create_raw(cm("after-drop"))
+    ev = w.get(timeout=5)
+    assert ev is not None and ev.object["metadata"]["name"] == "after-drop"
+    w.stop()
+
+
+def test_watch_410_relist_recovery():
+    """When the resume window is gone the reflector relists and keeps going."""
+    store = Store(watch_history_limit=4)
+    server = ApiServer(store).start()
+    remote = RemoteStore(server.base_url, timeout=5)
+    try:
+        w = remote.watch("v1", "ConfigMap", namespace="default")
+        # blow past the watch history while the stream is severed
+        with server._watch_lock:
+            for sw in list(server._active_watches):
+                sw.stop()
+        for i in range(8):
+            store.create_raw(cm(f"flood-{i}"))
+        seen = set()
+        deadline = time.time() + 10
+        while len(seen) < 8 and time.time() < deadline:
+            ev = w.get(timeout=0.5)
+            if ev is not None and ev.type == ADDED:
+                seen.add(ev.object["metadata"]["name"])
+        assert seen == {f"flood-{i}" for i in range(8)}
+        w.stop()
+    finally:
+        server.stop()
+
+
+def test_bearer_token_auth():
+    store = Store()
+    server = ApiServer(store, bearer_token="sekret").start()
+    try:
+        anon = RemoteStore(server.base_url, timeout=5)
+        with pytest.raises(UnauthorizedError):
+            anon.list_raw("v1", "ConfigMap")
+        authed = RemoteStore(server.base_url, token="sekret", timeout=5)
+        authed.create_raw(cm("locked"))
+        assert authed.get_raw("v1", "ConfigMap", "default", "locked")
+    finally:
+        server.stop()
+
+
+def test_tls_and_kubeconfig(tmp_path):
+    """HTTPS end-to-end with a generated CA + kubeconfig bootstrap — the
+    GetConfigOrDie path against our own apiserver."""
+    from odh_kubeflow_tpu.utils.certs import generate_cert_dir
+
+    ca, crt, key = generate_cert_dir(str(tmp_path / "pki"))
+    store = Store()
+    server = ApiServer(store, bearer_token="tok", certfile=crt, keyfile=key).start()
+    try:
+        host, port = server.address
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(
+            f"""
+apiVersion: v1
+kind: Config
+clusters:
+- name: local
+  cluster:
+    server: https://127.0.0.1:{port}
+    certificate-authority: {ca}
+contexts:
+- name: local
+  context: {{cluster: local, user: admin}}
+current-context: local
+users:
+- name: admin
+  user: {{token: tok}}
+"""
+        )
+        remote = RemoteStore.from_kubeconfig(str(kubeconfig))
+        remote.timeout = 5
+        remote.create_raw(cm("secure"))
+        assert remote.get_raw("v1", "ConfigMap", "default", "secure")["metadata"]["name"] == "secure"
+        w = remote.watch("v1", "ConfigMap", namespace="default")
+        remote.create_raw(cm("secure2"))
+        names = set()
+        deadline = time.time() + 10
+        while "secure2" not in names and time.time() < deadline:
+            ev = w.get(timeout=0.5)
+            if ev is not None:
+                names.add(ev.object["metadata"]["name"])
+        assert "secure2" in names
+        w.stop()
+    finally:
+        server.stop()
+
+
+def test_cluster_scoped_resources(served):
+    _, _, remote = served
+    node = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": "node-1", "labels": {"pool": "tpu"}},
+        "spec": {},
+    }
+    remote.create_raw(node)
+    assert remote.get_raw("v1", "Node", "", "node-1")["metadata"]["name"] == "node-1"
+    assert [o["metadata"]["name"] for o in remote.list_raw("v1", "Node")] == ["node-1"]
+    remote.delete_raw("v1", "Node", "", "node-1")
+    with pytest.raises(NotFoundError):
+        remote.get_raw("v1", "Node", "", "node-1")
+
+
+def test_spoke_version_over_http(served):
+    """Multi-version serving: the storage alias works across the wire."""
+    _, _, remote = served
+    nb = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "Notebook",
+        "metadata": {"name": "spoke", "namespace": "u"},
+        "spec": {"template": {"spec": {"containers": []}}},
+    }
+    remote.create_raw(nb)
+    got = remote.get_raw("kubeflow.org/v1beta1", "Notebook", "u", "spoke")
+    assert got["metadata"]["name"] == "spoke"
+
+
+def test_watch_label_selector_filtering(served):
+    """?watch=true&labelSelector=... filters the stream server-side."""
+    import json as _json
+    import urllib.request
+
+    _, server, remote = served
+    url = (
+        server.base_url
+        + "/api/v1/namespaces/default/configmaps?watch=true&labelSelector=app%3Dnb"
+    )
+    resp = urllib.request.urlopen(url, timeout=5)
+    try:
+        labeled = cm("match")
+        labeled["metadata"]["labels"] = {"app": "nb"}
+        remote.create_raw(cm("nomatch"))
+        remote.create_raw(labeled)
+        line = resp.readline()
+        ev = _json.loads(line)
+        assert ev["object"]["metadata"]["name"] == "match"
+    finally:
+        from odh_kubeflow_tpu.cluster.remote import _abort_stream
+
+        _abort_stream(resp)
