@@ -32,9 +32,11 @@ from __future__ import annotations
 import json
 import ssl
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
+
+from ..utils.httpserve import ThreadedHTTPServer, respond, serve_in_thread, shutdown
 
 from ..apimachinery import (
     ApiError,
@@ -49,12 +51,6 @@ from ..apimachinery import (
     match_labels,
 )
 from .store import Store, Watch
-
-
-class _HTTPServer(ThreadingHTTPServer):
-    # a manager opens one streaming watch per informed kind at startup —
-    # the stdlib listen backlog of 5 is too small for that burst
-    request_queue_size = 128
 
 # admission callout hook: (operation, object, old_object) -> mutated object.
 # Task of the webhook dispatcher (webhook/dispatch.py); None = store-only
@@ -154,8 +150,7 @@ class ApiServer:
             def do_DELETE(self):
                 server._dispatch(self, "DELETE")
 
-        self.httpd = _HTTPServer((host, port), Handler)
-        self.httpd.daemon_threads = True
+        self.httpd = ThreadedHTTPServer((host, port), Handler)
         self.tls = bool(certfile)
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -175,10 +170,7 @@ class ApiServer:
         return f"{'https' if self.tls else 'http'}://{host}:{port}"
 
     def start(self) -> "ApiServer":
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="apiserver", daemon=True
-        )
-        self._thread.start()
+        self._thread = serve_in_thread(self.httpd, "apiserver")
         return self
 
     def stop(self) -> None:
@@ -187,8 +179,7 @@ class ApiServer:
             for w in self._active_watches:
                 w.stop()
             self._active_watches.clear()
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        shutdown(self.httpd)
 
     # -- request plumbing --
 
@@ -284,20 +275,10 @@ class ApiServer:
         return body
 
     def _send_json(self, h: BaseHTTPRequestHandler, code: int, obj: Dict[str, Any]) -> None:
-        body = json.dumps(obj).encode()
-        h.send_response(code)
-        h.send_header("Content-Type", "application/json")
-        h.send_header("Content-Length", str(len(body)))
-        h.end_headers()
-        h.wfile.write(body)
+        respond(h, code, json.dumps(obj).encode())
 
     def _send_status_error(self, h: BaseHTTPRequestHandler, e: ApiError) -> None:
-        body = _status_body(e.code, e.reason, str(e))
-        h.send_response(e.code)
-        h.send_header("Content-Type", "application/json")
-        h.send_header("Content-Length", str(len(body)))
-        h.end_headers()
-        h.wfile.write(body)
+        respond(h, e.code, _status_body(e.code, e.reason, str(e)))
 
     # -- verbs --
 
@@ -378,7 +359,14 @@ class ApiServer:
         else:
             if not isinstance(patch, dict):
                 raise InvalidError("merge-patch body must be an object")
-            if self.admission is not None and route.subresource != "status":
+            admission_applies = (
+                self.admission is not None
+                and route.subresource != "status"
+                and getattr(self.admission, "matches_kind", lambda av, k: True)(
+                    route.api_version, route.kind
+                )
+            )
+            if admission_applies:
                 from ..apimachinery import json_merge_patch
 
                 current = self.store.get_raw(
@@ -431,12 +419,21 @@ class ApiServer:
                 h.wfile.write(f"{len(payload):x}\r\n".encode() + payload + b"\r\n")
                 h.wfile.flush()
 
+            idle_polls = 0
             while not self._stopping.is_set():
                 ev = w.get(timeout=0.5)
                 if ev is None:
                     if self._stopping.is_set() or w.stopped:
                         break  # server shutdown or stream severed: end cleanly
+                    idle_polls += 1
+                    if idle_polls >= 30:
+                        # heartbeat (BOOKMARK analog): a quiet kind would
+                        # otherwise never touch the socket, so a client gone
+                        # away would leak this handler thread + store watch
+                        send_chunk(b"\n")
+                        idle_polls = 0
                     continue
+                idle_polls = 0
                 if selector is not None and not match_labels(
                     selector, ev.object.get("metadata", {}).get("labels")
                 ):

@@ -47,6 +47,19 @@ class WebhookDispatcher:
 
     # -- ApiServer admission hook --
 
+    def matches_kind(self, api_version: str, kind: str) -> bool:
+        """Cheap precheck the API server uses to keep the atomic patch path
+        for kinds no webhook rule matches (a read-modify-write detour would
+        add a GET and spurious 409s to every unrelated patch)."""
+        group, _, version = api_version.rpartition("/")
+        plural = self.mapper.mapping_for(api_version, kind).plural
+        for cfg in self.store.list_raw(WEBHOOK_CONFIG_API_VERSION, WEBHOOK_CONFIG_KIND):
+            for wh in cfg.get("webhooks", []):
+                for op in ("CREATE", "UPDATE"):
+                    if self._matches(wh, op, group, version, plural):
+                        return True
+        return False
+
     def __call__(
         self, operation: str, obj: Dict[str, Any], old: Optional[Dict[str, Any]]
     ) -> Dict[str, Any]:

@@ -3,5 +3,6 @@ from .config import Config
 from .metrics import NotebookMetrics
 from .notebook import EventMirrorController, NotebookReconciler, hosts_service_name
 from .culling import CullingReconciler
+from .probe_status import ProbeStatusController
 from .webhook import NotebookWebhook
 from .extension import TPUWorkbenchReconciler

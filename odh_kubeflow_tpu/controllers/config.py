@@ -33,6 +33,9 @@ class Config:
     # TPU-native culling signal: require BOTH Jupyter-idle and TPU-idle
     tpu_idle_threshold: float = 0.05  # duty cycle below which the slice is idle
     probe_port: int = 8889
+    # device-visibility readiness gate (controllers/probe_status.py): poll
+    # cadence for /tpu/readiness until the mesh gate is green
+    readiness_probe_period_s: float = 10.0
 
     # extension controller / webhook (reference odh main.go + webhook consts)
     auth_proxy_image: str = "kube-rbac-proxy:latest"
@@ -64,4 +67,6 @@ class Config:
         c.inject_cluster_proxy_env = _env_bool(
             "INJECT_CLUSTER_PROXY_ENV", c.inject_cluster_proxy_env
         )
+        if os.environ.get("READINESS_PROBE_PERIOD_S"):
+            c.readiness_probe_period_s = float(os.environ["READINESS_PROBE_PERIOD_S"])
         return c

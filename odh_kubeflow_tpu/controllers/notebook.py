@@ -18,7 +18,6 @@ updateNotebookStatus :299-374, setPrefixEnvVar :417-431):
 from __future__ import annotations
 
 import logging
-import time
 from typing import List, Optional
 
 from ..api.apps import StatefulSet
@@ -40,7 +39,6 @@ from ..apimachinery import (
     Condition,
     NotFoundError,
     now_rfc3339,
-    parse_time,
 )
 from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
@@ -338,7 +336,6 @@ class NotebookReconciler:
             if primary is not None:
                 status.container_state = primary.state
 
-        newly_ready = False
         if shape is not None:
             status.tpu = status.tpu or TPUStatus()
             status.tpu.accelerator = shape.accelerator
@@ -347,33 +344,25 @@ class NotebookReconciler:
             status.tpu.chips_per_host = shape.chips_per_host
             status.tpu.chips_expected = shape.chips
             status.tpu.hosts_ready = ready_pods
-            # chips_visible / mesh_ready are refined by the probe reports;
-            # host readiness is the lower bound (see controllers/probe_status)
-            if status.tpu.chips_visible < ready_pods * shape.chips_per_host:
-                status.tpu.chips_visible = ready_pods * shape.chips_per_host
-            status.tpu.mesh_ready = ready_pods == shape.hosts and shape.hosts > 0
-            if status.tpu.mesh_ready and not status.tpu.first_ready_time:
-                # the north-star metric: CR creation -> FIRST slice readiness
-                # (cull/restart cycles must not re-observe days-long values)
-                status.tpu.first_ready_time = now_rfc3339()
-                newly_ready = True
+            # chips_visible / mesh_ready / first_ready_time are OWNED by the
+            # device-visibility gate (controllers/probe_status.py): pod-Ready
+            # alone must never flip them — a host whose libtpu sees 2 of 4
+            # chips keeps mesh_ready false even with every pod Ready
 
         def write():
             cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            if shape is not None and cur.status.tpu is not None:
+                # preserve the probe controller's fields (two status writers,
+                # disjoint field ownership)
+                status.tpu.chips_visible = cur.status.tpu.chips_visible
+                status.tpu.mesh_ready = cur.status.tpu.mesh_ready
+                status.tpu.first_ready_time = cur.status.tpu.first_ready_time
             if cur.status.to_dict() == status.to_dict():
                 return cur
             cur.status = status
             return self.client.update_status(cur)
 
         retry_on_conflict(write)
-        if newly_ready:
-            # observe only after first_ready_time persisted — a failed write
-            # retries the whole reconcile and would double-count the histogram
-            try:
-                created = parse_time(nb.metadata.creation_timestamp).timestamp()
-                self.metrics.slice_ready_seconds.observe(time.time() - created)
-            except (ValueError, TypeError):
-                pass
 
     def _handle_restart(self, nb: Notebook) -> None:
         """notebooks.opendatahub.io/notebook-restart handling (reference

@@ -1,0 +1,197 @@
+"""Probe-status controller: device-visibility-gated slice readiness.
+
+SURVEY §7 hard part (a), made real: "when is a slice ready?" is answered by
+the in-pod probe contract, not by pod phase. Every ordinal's agent serves
+GET /tpu/readiness -> {"chips_visible", "chips_expected", "ready"}
+(probe/agent.py:181-189); this controller polls all hosts and owns the
+device-level slice of NotebookStatus.tpu:
+
+- chips_visible  = SUM of per-host reported chips (a host whose libtpu sees
+  2 of 4 chips contributes 2 — pod-Ready alone never inflates this),
+- mesh_ready     = every host reports ready (visible >= expected) AND every
+  pod is Ready,
+- first_ready_time + the notebook_slice_ready_seconds histogram fire at THAT
+  moment — so the north-star metric (Notebook CR -> jax.devices() ready)
+  measures device visibility, not kubelet bookkeeping.
+
+The reconciler is requeue-driven at a fixed cadence like the culler
+(reference culling_controller.go:86-203's RequeueAfter pattern); the pod-fact
+fields (hosts_ready, chips_expected, ...) stay owned by the core reconciler
+(controllers/notebook.py) and both writers preserve each other's fields.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import List, Optional, Tuple
+
+from ..api.apps import StatefulSet
+from ..api.core import Pod
+from ..api.notebook import Notebook, TPUStatus
+from ..apimachinery import NotFoundError, now_rfc3339, parse_time
+from ..cluster.client import retry_on_conflict
+from ..runtime.controller import Request, Result
+from ..runtime.manager import Manager
+from ..tpu import plan_slice
+from . import constants as C
+from .config import Config
+from .culling import HTTPGet, _default_http_get
+from .metrics import NotebookMetrics
+from .notebook import hosts_service_name
+
+log = logging.getLogger(__name__)
+
+
+class ProbeStatusController:
+    def __init__(
+        self,
+        manager: Manager,
+        config: Optional[Config] = None,
+        http_get: Optional[HTTPGet] = None,
+        metrics: Optional[NotebookMetrics] = None,
+    ):
+        self.manager = manager
+        self.client = manager.client
+        self.config = config or Config()
+        self.http_get = http_get or _default_http_get
+        self.metrics = metrics or NotebookMetrics(manager.metrics)
+
+    def setup(self) -> None:
+        self.manager.builder("probe-status").for_(Notebook).complete(self.reconcile)
+
+    # ---------- probing ----------
+
+    def readiness_urls(self, nb: Notebook, hosts: int) -> List[str]:
+        """One /tpu/readiness endpoint per ordinal, over per-pod DNS (same
+        address scheme as the culler's utilization probe)."""
+        svc = hosts_service_name(nb.metadata.name)
+        try:
+            sts = self.client.get(StatefulSet, nb.metadata.namespace, nb.metadata.name)
+            if sts.spec.service_name:
+                svc = sts.spec.service_name
+        except NotFoundError:
+            pass
+        return [
+            f"http://{nb.metadata.name}-{i}.{svc}.{nb.metadata.namespace}.svc."
+            f"{self.config.cluster_domain}:{self.config.probe_port}/tpu/readiness"
+            for i in range(hosts)
+        ]
+
+    PROBE_TIMEOUT_S = 2.0
+
+    def collect_reports(self, nb: Notebook, hosts: int) -> List[Optional[dict]]:
+        """Per-ordinal readiness reports; None for unreachable hosts.
+
+        Probes run concurrently with a short timeout: the controller has one
+        worker shared across all notebooks, and bring-up is exactly when DNS
+        blackholes — N sequential 10s timeouts would starve every other
+        slice's readiness detection."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        def probe(url: str) -> Optional[dict]:
+            try:
+                try:
+                    status, body = self.http_get(url, timeout=self.PROBE_TIMEOUT_S)
+                except TypeError:  # custom http_get without timeout kwarg
+                    status, body = self.http_get(url)
+                if status != 200:
+                    raise ConnectionError(f"GET {url} -> {status}")
+                return json.loads(body.decode() or "null")
+            except Exception:
+                return None
+
+        urls = self.readiness_urls(nb, hosts)
+        if not urls:
+            return []
+        with ThreadPoolExecutor(max_workers=min(16, len(urls))) as pool:
+            return list(pool.map(probe, urls))
+
+    # ---------- reconcile ----------
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        period_s = self.config.readiness_probe_period_s
+        try:
+            nb = self.client.get(Notebook, req.namespace, req.name)
+        except NotFoundError:
+            return None
+        if nb.metadata.deletion_timestamp:
+            return None
+        if nb.spec.tpu is None or not nb.spec.tpu.accelerator:
+            return None  # CPU notebook: no device gate
+        if C.STOP_ANNOTATION in nb.metadata.annotations:
+            # stopped slices have no devices; clear the gate but keep
+            # first_ready_time (it anchors the FIRST bring-up latency)
+            self._write(nb, chips_visible=0, mesh_ready=False, newly_ready=False)
+            return None
+
+        shape = plan_slice(
+            nb.spec.tpu.accelerator, nb.spec.tpu.topology, nb.spec.tpu.chips
+        )
+        pods = [
+            p
+            for p in self.client.list(
+                Pod,
+                namespace=nb.metadata.namespace,
+                labels={C.NOTEBOOK_NAME_LABEL: nb.metadata.name},
+            )
+            if not p.metadata.deletion_timestamp
+        ]
+        ready_pods = sum(
+            1
+            for p in pods
+            if any(c.type == "Ready" and c.status == "True" for c in p.status.conditions)
+        )
+
+        reports = self.collect_reports(nb, shape.hosts)
+        chips_visible = sum(int(r.get("chips_visible", 0)) for r in reports if r)
+        hosts_reporting_ready = sum(1 for r in reports if r and r.get("ready"))
+        mesh_ready = (
+            shape.hosts > 0
+            and hosts_reporting_ready == shape.hosts
+            and ready_pods == shape.hosts
+        )
+
+        newly_ready = mesh_ready and not (
+            nb.status.tpu and nb.status.tpu.first_ready_time
+        )
+        self._write(nb, chips_visible, mesh_ready, newly_ready)
+        if newly_ready:
+            # observe only after the write persisted (double-count guard)
+            try:
+                created = parse_time(nb.metadata.creation_timestamp).timestamp()
+                self.metrics.slice_ready_seconds.observe(time.time() - created)
+            except (ValueError, TypeError):
+                pass
+            log.info(
+                "slice ready: %s (%d chips over %d hosts)",
+                req.key,
+                chips_visible,
+                shape.hosts,
+            )
+        # keep polling until the mesh gate is green; afterwards stay on a slow
+        # heartbeat so chip loss (e.g. a host losing devices) is re-detected
+        return Result(requeue_after=period_s if not mesh_ready else period_s * 6)
+
+    # ---------- status write (owns ONLY the device-gate fields) ----------
+
+    def _write(
+        self, nb: Notebook, chips_visible: int, mesh_ready: bool, newly_ready: bool
+    ) -> None:
+        def attempt():
+            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            tpu = cur.status.tpu or TPUStatus()
+            changed = (
+                tpu.chips_visible != chips_visible or tpu.mesh_ready != mesh_ready
+            )
+            tpu.chips_visible = chips_visible
+            tpu.mesh_ready = mesh_ready
+            if newly_ready and not tpu.first_ready_time:
+                tpu.first_ready_time = now_rfc3339()
+                changed = True
+            if not changed:
+                return cur
+            cur.status.tpu = tpu
+            return self.client.update_status(cur)
+
+        retry_on_conflict(attempt)

@@ -17,6 +17,7 @@ from .controllers import (
     EventMirrorController,
     NotebookReconciler,
     NotebookWebhook,
+    ProbeStatusController,
     TPUWorkbenchReconciler,
 )
 from .controllers.metrics import NotebookMetrics
@@ -52,6 +53,7 @@ def build_manager(
     NotebookReconciler(mgr, config, metrics=metrics).setup()
     EventMirrorController(mgr).setup()
     TPUWorkbenchReconciler(mgr, config).setup()
+    ProbeStatusController(mgr, config, http_get=http_get, metrics=metrics).setup()
     CullingReconciler(mgr, config, http_get=http_get, metrics=metrics).setup()
     return mgr
 
@@ -101,7 +103,9 @@ def main() -> None:  # pragma: no cover - thin CLI shell
                 Client(store),
                 config,
                 cert_dir,
-                port=int(os.environ.get("WEBHOOK_PORT", "8443")),
+                # deploy webhook Service targets 9443 (controller-runtime's
+                # default serving port; see deploy/manifests.py webhook_service)
+                port=int(os.environ.get("WEBHOOK_PORT", "9443")),
             )
             log.info("mutating webhook serving on :%s", webhook_server.httpd.server_address[1])
         mgr = build_manager(store, config, leader_election=True)
@@ -113,6 +117,12 @@ def main() -> None:  # pragma: no cover - thin CLI shell
         mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
         log.info("tpu-notebook-controller running (in-process cluster)")
     mgr.start()
+    # /metrics on :8080, /healthz + /readyz on :8081 (reference
+    # notebook-controller/main.go:125-133; deploy probes point here)
+    endpoints = mgr.serve_endpoints(
+        metrics_port=int(os.environ.get("METRICS_PORT", "8080")),
+        health_port=int(os.environ.get("HEALTH_PORT", "8081")),
+    )
     try:
         import signal
         import threading
@@ -123,6 +133,7 @@ def main() -> None:  # pragma: no cover - thin CLI shell
         stop.wait()
     finally:
         mgr.stop()
+        endpoints.stop()
         if webhook_server is not None:
             webhook_server.stop()
         if cluster is not None:

@@ -231,14 +231,20 @@ class NotebookAgent:
 
 
 def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
-                       kernels_busy: bool = True, chips: Optional[int] = None):
+                       kernels_busy: bool = True, chips: Optional[int] = None,
+                       visible_chips: Optional[Any] = None):
     """Kubelet-sim pod behavior running one NotebookAgent per notebook pod.
 
     The shared fixture for tests, bench.py and the loadtest: caches one agent
     per (pod name, uid) — the kubelet calls the behavior on every reconcile,
     so the served state and the caller's handle must not diverge — and
     aliases it under the bare pod name for scripting (`agents["nb-0"]`).
-    Chips default to the pod's `google.com/tpu` request."""
+    Chips default to the pod's `google.com/tpu` request.
+
+    visible_chips degrades REPORTED visibility from agent birth (expected
+    stays at the pod's request) — int for all pods, or {pod_name: chips} for
+    per-host degradation; scripting it post-hoc via agents[...] races the
+    probe controller's first poll."""
     from ..controllers import constants as C
     from ..tpu import TPU_RESOURCE
 
@@ -250,7 +256,12 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
             n_chips = chips
             if n_chips is None:
                 n_chips = sum(
-                    int((c.resources.requests or {}).get(TPU_RESOURCE, "0") or 0)
+                    int(
+                        ((c.resources.requests if c.resources else None) or {}).get(
+                            TPU_RESOURCE, "0"
+                        )
+                        or 0
+                    )
                     for c in pod.spec.containers
                 )
             kernels = KernelState()
@@ -258,8 +269,13 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
                 kernels.set_busy()
             else:
                 kernels.set_idle(time.time())
+            visible = n_chips
+            if isinstance(visible_chips, dict):
+                visible = visible_chips.get(pod.metadata.name, n_chips)
+            elif visible_chips is not None:
+                visible = visible_chips
             agent = NotebookAgent(
-                monitor=SimTPUMonitor(chips=n_chips, expected=n_chips, duty=duty),
+                monitor=SimTPUMonitor(chips=visible, expected=n_chips, duty=duty),
                 kernels=kernels,
             )
             agents[key] = agent

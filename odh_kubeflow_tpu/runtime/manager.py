@@ -103,7 +103,14 @@ class LeaderElector:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            acquired = self._try_acquire()
+            try:
+                acquired = self._try_acquire()
+            except Exception as e:
+                # transient API/transport errors must not kill the elector
+                # thread (a dead elector with is_leader still set is silent
+                # split-brain); treat the tick as not-acquired and retry
+                log.warning("leader election tick failed: %r", e)
+                acquired = False
             was_leader = self.is_leader.is_set()
             if acquired:
                 self.is_leader.set()
@@ -161,14 +168,23 @@ class Manager:
     def add_runnable(self, fn: Callable[[], None]) -> None:
         self._runnables.append(fn)
 
-    def start(self, wait_for_leadership_timeout: float = 10.0) -> None:
+    def start(self, wait_for_leadership_timeout: Optional[float] = None) -> None:
+        """With leader election, blocks until leadership is acquired —
+        indefinitely by default, as controller-runtime does: during a rolling
+        update the incoming replica must WAIT out the old lease, not crash
+        into CrashLoopBackOff. A timeout is for tests."""
         if self._started:
             return
         if self.elector is not None:
             self.elector.on_stopped_leading = self.stop
             self.elector.start()
-            if not self.elector.is_leader.wait(timeout=wait_for_leadership_timeout):
-                raise TimeoutError("failed to acquire leadership")
+            if wait_for_leadership_timeout is not None:
+                if not self.elector.is_leader.wait(timeout=wait_for_leadership_timeout):
+                    raise TimeoutError("failed to acquire leadership")
+            else:
+                while not self.elector.is_leader.wait(timeout=1.0):
+                    if self.elector._stop.is_set():
+                        return
         self.informers.start_all()
         for ctrl in self.controllers:
             ctrl.start()
@@ -184,12 +200,42 @@ class Manager:
             self.elector.stop()
         self._started = False
 
-    # health endpoints contract (healthz/readyz — both reference main.go files)
+    # health endpoints contract (healthz/readyz — both reference main.go
+    # files bind ping handlers at :8081; here the checks are real)
     def healthz(self) -> bool:
+        """Liveness: no controller worker thread has died, and once started,
+        leadership (when enabled) is still held."""
+        for ctrl in self.controllers:
+            for t in getattr(ctrl, "_threads", []):
+                if not t.is_alive():
+                    return False
+        if self._started and self.elector is not None:
+            t = self.elector._thread
+            if t is not None and not t.is_alive():
+                return False  # dead elector = undetectable lease loss
+            if not self.elector.is_leader.is_set():
+                return False
         return True
 
     def readyz(self) -> bool:
-        return self._started
+        """Readiness: started and every informer cache has synced."""
+        if not self._started:
+            return False
+        for inf in self.informers._informers.values():
+            if not inf.synced.is_set():
+                return False
+        return True
+
+    def serve_endpoints(self, metrics_port: int = 8080, health_port: int = 8081,
+                        host: str = "0.0.0.0"):
+        """Bind /metrics (Prometheus exposition) and /healthz + /readyz —
+        reference notebook-controller/main.go:125-133."""
+        from .serving import ServingEndpoints
+
+        server = ServingEndpoints(
+            self, metrics_port=metrics_port, health_port=health_port, host=host
+        ).start()
+        return server
 
     def wait_idle(self, timeout: float = 30.0) -> bool:
         """Test/bench helper: wait for every controller queue to drain."""

@@ -1,0 +1,94 @@
+"""Manager serving endpoints: /metrics, /healthz, /readyz over HTTP.
+
+Both reference managers bind these (reference notebook-controller
+main.go:87-94,125-133: metrics on :8080 via controller-runtime's registry,
+health/ready pings on :8081; the ODH manager likewise, main.go:117-245), and
+the deploy manifests point kubelet probes at them
+(odh config/manager/manager.yaml:37-47 — mirrored by our
+deploy/manifests.py manager Deployment). This module gives the Manager the
+same surface: Prometheus text exposition from the in-tree Registry, and
+health/readiness checks that reflect actual controller/informer liveness
+rather than returning a constant.
+"""
+from __future__ import annotations
+
+from http.server import BaseHTTPRequestHandler
+from typing import Tuple
+
+from ..utils.httpserve import ThreadedHTTPServer, respond, serve_in_thread, shutdown
+from .metrics import Registry
+
+
+class ServingEndpoints:
+    """One listener per concern, like the reference (metrics :8080, probes
+    :8081); port 0 picks free ports for tests."""
+
+    def __init__(
+        self,
+        manager,
+        metrics_port: int = 8080,
+        health_port: int = 8081,
+        host: str = "0.0.0.0",
+    ):
+        self.manager = manager
+        registry: Registry = manager.metrics
+
+        serving = self
+
+        class MetricsHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path.split("?")[0] != "/metrics":
+                    serving._respond(self, 404, b"not found\n")
+                    return
+                body = registry.render().encode()
+                serving._respond(
+                    self, 200, body, content_type="text/plain; version=0.0.4"
+                )
+
+        class HealthHandler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    ok = serving.manager.healthz()
+                elif path == "/readyz":
+                    ok = serving.manager.readyz()
+                else:
+                    serving._respond(self, 404, b"not found\n")
+                    return
+                serving._respond(self, 200 if ok else 500, b"ok\n" if ok else b"unhealthy\n")
+
+        self.metrics_httpd = ThreadedHTTPServer((host, metrics_port), MetricsHandler)
+        self.health_httpd = ThreadedHTTPServer((host, health_port), HealthHandler)
+        self._threads: list = []
+
+    @staticmethod
+    def _respond(h: BaseHTTPRequestHandler, code: int, body: bytes,
+                 content_type: str = "text/plain") -> None:
+        respond(h, code, body, content_type)
+
+    @property
+    def metrics_address(self) -> Tuple[str, int]:
+        return self.metrics_httpd.server_address[:2]
+
+    @property
+    def health_address(self) -> Tuple[str, int]:
+        return self.health_httpd.server_address[:2]
+
+    def start(self) -> "ServingEndpoints":
+        for httpd, name in ((self.metrics_httpd, "metrics"), (self.health_httpd, "health")):
+            self._threads.append(serve_in_thread(httpd, f"serving-{name}"))
+        return self
+
+    def stop(self) -> None:
+        for httpd in (self.metrics_httpd, self.health_httpd):
+            shutdown(httpd)

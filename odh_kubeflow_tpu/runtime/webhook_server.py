@@ -18,11 +18,12 @@ import json
 import logging
 import ssl
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Callable, Dict, Optional
 
 from ..apimachinery import json_patch_diff
 from ..cluster.store import AdmissionRequest
+from ..utils.httpserve import ThreadedHTTPServer, respond, serve_in_thread, shutdown
 
 log = logging.getLogger(__name__)
 
@@ -52,11 +53,7 @@ class WebhookServer:
             def do_POST(self):
                 server._handle(self)
 
-        class _Server(ThreadingHTTPServer):
-            request_queue_size = 128
-
-        self.httpd = _Server((host, port), Handler)
-        self.httpd.daemon_threads = True
+        self.httpd = ThreadedHTTPServer((host, port), Handler)
         self.tls = bool(certfile)
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -75,15 +72,11 @@ class WebhookServer:
         return f"{'https' if self.tls else 'http'}://{host}:{port}"
 
     def start(self) -> "WebhookServer":
-        self._thread = threading.Thread(
-            target=self.httpd.serve_forever, name="webhook-server", daemon=True
-        )
-        self._thread.start()
+        self._thread = serve_in_thread(self.httpd, "webhook-server")
         return self
 
     def stop(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        shutdown(self.httpd)
 
     # -- request handling --
 
@@ -147,9 +140,4 @@ class WebhookServer:
         return response
 
     def _respond_raw(self, h: BaseHTTPRequestHandler, code: int, body: Dict) -> None:
-        raw = json.dumps(body).encode()
-        h.send_response(code)
-        h.send_header("Content-Type", "application/json")
-        h.send_header("Content-Length", str(len(raw)))
-        h.end_headers()
-        h.wfile.write(raw)
+        respond(h, code, json.dumps(body).encode())

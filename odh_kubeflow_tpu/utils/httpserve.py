@@ -1,0 +1,44 @@
+"""Shared scaffolding for the build's threaded HTTP servers.
+
+Three components serve HTTP (the API server, the admission webhook server,
+and the manager's metrics/health endpoints); they share this base so
+connection-handling fixes land once: daemon handler threads, a listen
+backlog sized for a manager's startup burst of watch connections, and
+Content-Length-framed responses that keep HTTP/1.1 keep-alive correct.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class ThreadedHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # a manager opens one streaming watch per informed kind at startup —
+    # the stdlib default backlog of 5 drops connections under that burst
+    request_queue_size = 128
+
+
+def respond(
+    h: BaseHTTPRequestHandler,
+    code: int,
+    body: bytes,
+    content_type: str = "application/json",
+) -> None:
+    """Framed response (explicit Content-Length so keep-alive stays sound)."""
+    h.send_response(code)
+    h.send_header("Content-Type", content_type)
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    h.wfile.write(body)
+
+
+def serve_in_thread(httpd: ThreadingHTTPServer, name: str) -> threading.Thread:
+    t = threading.Thread(target=httpd.serve_forever, name=name, daemon=True)
+    t.start()
+    return t
+
+
+def shutdown(httpd: ThreadingHTTPServer) -> None:
+    httpd.shutdown()
+    httpd.server_close()

@@ -22,6 +22,7 @@ from odh_kubeflow_tpu.controllers.extension import (
     route_name,
 )
 from odh_kubeflow_tpu.main import build_manager
+from odh_kubeflow_tpu.probe import sim_agent_behavior
 
 CTRL_NS = "tpu-notebooks-system"
 
@@ -31,9 +32,11 @@ def env():
     cluster = SimCluster().start()
     cluster.add_cpu_pool("cpu", nodes=2)
     cluster.add_tpu_pool("v5e", "v5e", "2x2")
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents))
     config = Config(controller_namespace=CTRL_NS, set_pipeline_rbac=True,
-                    set_pipeline_secret=True)
-    mgr = build_manager(cluster.store, config)
+                    set_pipeline_secret=True, readiness_probe_period_s=0.3)
+    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
     mgr.start()
     yield cluster, mgr, config
     mgr.stop()

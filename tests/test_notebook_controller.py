@@ -13,8 +13,10 @@ from odh_kubeflow_tpu.controllers import (
     Config,
     EventMirrorController,
     NotebookReconciler,
+    ProbeStatusController,
     constants as C,
 )
+from odh_kubeflow_tpu.probe import sim_agent_behavior
 from odh_kubeflow_tpu.runtime import Manager
 from odh_kubeflow_tpu.tpu import TPU_RESOURCE
 
@@ -24,9 +26,13 @@ def env():
     """SimCluster + a separate product manager (mirrors the reference's
     two-process layout against one API server)."""
     cluster = SimCluster().start()
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents))
+    cfg = Config(readiness_probe_period_s=0.3)
     mgr = Manager(cluster.store)
-    NotebookReconciler(mgr, Config()).setup()
+    NotebookReconciler(mgr, cfg).setup()
     EventMirrorController(mgr).setup()
+    ProbeStatusController(mgr, cfg, http_get=cluster.http_get).setup()
     mgr.start()
     yield cluster, mgr
     mgr.stop()

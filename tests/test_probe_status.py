@@ -1,0 +1,167 @@
+"""Device-visibility readiness gate (controllers/probe_status.py).
+
+SURVEY §7 hard part (a) / VERDICT-r1 acceptance: mesh_ready must reflect what
+the hosts' TPU runtimes actually report, not kubelet pod conditions — a host
+whose libtpu sees 2 of 4 chips keeps the slice NOT mesh-ready even while all
+pods are Ready.
+"""
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.api.core import Container
+from odh_kubeflow_tpu.apimachinery import NotFoundError
+from odh_kubeflow_tpu.cluster import SimCluster
+from odh_kubeflow_tpu.controllers import Config, constants as C
+from odh_kubeflow_tpu.main import build_manager
+from odh_kubeflow_tpu.probe import sim_agent_behavior
+
+NS = "probe-user"
+
+
+@pytest.fixture()
+def env():
+    cluster = SimCluster().start()
+    cluster.add_cpu_pool("cpu", nodes=1)
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=2)
+    cluster.add_tpu_pool("v5p", "v5p", "2x2x4", slices=1)
+    agents = {}
+    # dim-0 / big-2 are born with degraded visibility (setting it after the
+    # pod starts would race the probe controller's first poll)
+    cluster.add_pod_behavior(
+        sim_agent_behavior(agents, visible_chips={"dim-0": 2, "big-2": 3})
+    )
+    config = Config(readiness_probe_period_s=0.2)
+    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    mgr.start()
+    yield cluster, agents
+    mgr.stop()
+    cluster.stop()
+
+
+def mk_nb(name, topology="2x2", accelerator="v5e"):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = NS
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    nb.spec.tpu = TPUSpec(accelerator=accelerator, topology=topology)
+    return nb
+
+
+def wait_for(fn, timeout=20, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+        except NotFoundError:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def get_nb(cluster, name):
+    return cluster.client.get(Notebook, NS, name)
+
+
+def test_partial_chip_visibility_blocks_mesh_ready(env):
+    """Pods Ready but one host reports 2/4 chips -> mesh_ready stays false
+    and chips_visible reports the true count; full visibility flips it."""
+    cluster, agents = env
+    cluster.client.create(mk_nb("dim"))  # dim-0 reports 2/4 from birth
+    wait_for(
+        lambda: get_nb(cluster, "dim").status.ready_replicas == 1,
+        msg="pod ready",
+    )
+    # give the probe loop several cycles: the gate must hold at 2 chips
+    wait_for(
+        lambda: (get_nb(cluster, "dim").status.tpu or None)
+        and get_nb(cluster, "dim").status.tpu.chips_visible == 2,
+        msg="probe saw 2 chips",
+    )
+    nb = get_nb(cluster, "dim")
+    assert nb.status.ready_replicas == 1  # pods ARE ready...
+    assert nb.status.tpu.mesh_ready is False  # ...but the slice is NOT
+    assert nb.status.tpu.first_ready_time == ""
+
+    # chips appear -> gate opens, first_ready_time anchors the latency metric
+    agents["dim-0"].monitor.chips = 4
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(get_nb(cluster, "dim")),
+        msg="mesh ready",
+    )
+    assert nb.status.tpu.chips_visible == 4
+    assert nb.status.tpu.first_ready_time != ""
+
+
+def test_multihost_gate_requires_every_host(env):
+    """v5p 2x2x4 = 4 hosts: one degraded host (3/4 chips) holds the whole
+    slice; chips_visible aggregates per-host reports (15, not 16)."""
+    cluster, agents = env
+    cluster.client.create(mk_nb("big", topology="2x2x4", accelerator="v5p"))
+    wait_for(
+        lambda: get_nb(cluster, "big").status.ready_replicas == 4,
+        msg="all pods ready",
+        timeout=45,
+    )
+    wait_for(
+        lambda: (get_nb(cluster, "big").status.tpu or None)
+        and get_nb(cluster, "big").status.tpu.chips_visible == 15,
+        msg="aggregated 15 chips",
+    )
+    assert get_nb(cluster, "big").status.tpu.mesh_ready is False
+
+    agents["big-2"].monitor.chips = 4
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(get_nb(cluster, "big")),
+        msg="mesh ready",
+    )
+    assert nb.status.tpu.chips_visible == 16
+
+
+def test_chip_loss_after_ready_revokes_gate_but_keeps_first_ready(env):
+    """The heartbeat re-detects chip loss; first_ready_time is immutable."""
+    cluster, agents = env
+    cluster.client.create(mk_nb("flaky"))
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(get_nb(cluster, "flaky")),
+        msg="initially ready",
+    )
+    first = nb.status.tpu.first_ready_time
+    assert first
+
+    agents["flaky-0"].monitor.chips = 1
+    nb = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and not n.status.tpu.mesh_ready else None
+        )(get_nb(cluster, "flaky")),
+        msg="gate revoked",
+    )
+    assert nb.status.tpu.chips_visible == 1
+    assert nb.status.tpu.first_ready_time == first
+
+
+def test_unreachable_probe_keeps_gate_closed(env):
+    """No reachable agent (probe-less image): ready pods alone do not open
+    the gate — device truth is required."""
+    cluster, agents = env
+    nb = mk_nb("mute")
+    cluster.client.create(nb)
+    wait_for(lambda: "mute-0" in agents, msg="agent")
+    # sever the probe: agent reports errors by closing its server
+    agents["mute-0"].close()
+    wait_for(
+        lambda: get_nb(cluster, "mute").status.ready_replicas == 1,
+        msg="pod ready",
+    )
+    time.sleep(1.0)  # several probe periods
+    tpu = get_nb(cluster, "mute").status.tpu
+    assert tpu is None or tpu.mesh_ready is False

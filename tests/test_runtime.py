@@ -168,3 +168,80 @@ def test_leader_election_exclusive():
     e1.stop()
     assert e2.is_leader.wait(5)
     e2.stop()
+
+
+# ---- serving endpoints (/metrics, /healthz, /readyz) ----
+
+
+def _http_get(url):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode(), dict(resp.headers)
+
+
+def test_serving_endpoints_metrics_and_health():
+    """reference notebook-controller/main.go:125-133: metrics on one port,
+    health pings on another; here with real liveness/readiness semantics."""
+    import urllib.error
+
+    from odh_kubeflow_tpu.api.core import ConfigMap
+    from odh_kubeflow_tpu.cluster.store import Store
+    from odh_kubeflow_tpu.runtime.manager import Manager
+    from odh_kubeflow_tpu.runtime.metrics import Registry
+
+    registry = Registry()
+    counter = registry.counter("notebook_create_total", "Total creates")
+    mgr = Manager(Store(), metrics_registry=registry)
+    mgr.informers.informer_for(ConfigMap)
+    server = mgr.serve_endpoints(metrics_port=0, health_port=0, host="127.0.0.1")
+    try:
+        mhost, mport = server.metrics_address
+        hhost, hport = server.health_address
+
+        # not started yet: alive but not ready
+        status, body, _ = _http_get(f"http://{mhost}:{mport}/metrics")
+        assert status == 200 and "notebook_create_total" in body
+        status, body, _ = _http_get(f"http://{hhost}:{hport}/healthz")
+        assert status == 200 and body == "ok\n"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _http_get(f"http://{hhost}:{hport}/readyz")
+        assert exc.value.code == 500
+
+        mgr.start()
+        status, body, _ = _http_get(f"http://{hhost}:{hport}/readyz")
+        assert status == 200
+
+        counter.inc()
+        status, body, headers = _http_get(f"http://{mhost}:{mport}/metrics")
+        assert "notebook_create_total 1" in body
+        assert headers["Content-Type"].startswith("text/plain")
+
+        with pytest.raises(urllib.error.HTTPError):
+            _http_get(f"http://{hhost}:{hport}/nope")
+    finally:
+        server.stop()
+        mgr.stop()
+
+
+def test_healthz_reports_dead_controller_thread():
+    from odh_kubeflow_tpu.cluster.store import Store
+    from odh_kubeflow_tpu.runtime.manager import Manager
+
+    mgr = Manager(Store())
+
+    class DeadThread:
+        def is_alive(self):
+            return False
+
+    class FakeCtrl:
+        _threads = [DeadThread()]
+
+        def start(self):
+            pass
+
+        def stop(self):
+            pass
+
+    mgr.controllers.append(FakeCtrl())
+    assert mgr.healthz() is False
