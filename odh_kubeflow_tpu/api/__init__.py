@@ -1,2 +1,2 @@
-from . import admission, apps, coordination, core, gateway, networking, rbac
+from . import admission, apps, coordination, core, dspa, gateway, networking, rbac
 from . import notebook

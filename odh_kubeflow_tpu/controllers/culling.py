@@ -33,7 +33,7 @@ from ..tpu import plan_slice
 from . import constants as C
 from .config import Config
 from .metrics import NotebookMetrics
-from .notebook import hosts_service_name
+from .notebook import hosts_service_name, per_ordinal_probe_urls, statefulset_name
 
 log = logging.getLogger(__name__)
 
@@ -93,20 +93,9 @@ class CullingReconciler:
         shape = plan_slice(
             nb.spec.tpu.accelerator, nb.spec.tpu.topology, nb.spec.tpu.chips
         )
-        # per-pod DNS rides the StatefulSet's ACTUAL serviceName (immutable in
-        # real k8s — an STS created before a rename keeps its old headless svc)
-        svc = hosts_service_name(nb.metadata.name)
-        try:
-            sts = self.client.get(StatefulSet, nb.metadata.namespace, nb.metadata.name)
-            if sts.spec.service_name:
-                svc = sts.spec.service_name
-        except NotFoundError:
-            pass
-        return [
-            f"http://{nb.metadata.name}-{i}.{svc}.{nb.metadata.namespace}.svc."
-            f"{self.config.cluster_domain}:{self.config.probe_port}/tpu/utilization"
-            for i in range(shape.hosts)
-        ]
+        return per_ordinal_probe_urls(
+            self.client, self.config, nb, shape.hosts, "/tpu/utilization"
+        )
 
     # ---------- probes ----------
 
@@ -183,7 +172,9 @@ class CullingReconciler:
 
         # pod 0 gone: nothing to probe (reference :120-135)
         try:
-            self.client.get(Pod, nb.metadata.namespace, f"{nb.metadata.name}-0")
+            self.client.get(
+                Pod, nb.metadata.namespace, f"{statefulset_name(nb.metadata.name)}-0"
+            )
         except NotFoundError:
             self._remove_activity_annotations(nb)
             return Result(requeue_after=period_s)

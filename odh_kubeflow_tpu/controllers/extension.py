@@ -353,43 +353,7 @@ class TPUWorkbenchReconciler:
         """Sync ConfigMaps labeled runtime-image in the controller ns into a
         per-user-ns `pipeline-runtime-images` ConfigMap (ImageStream-list
         analog, reference notebook_runtime.go:43-152)."""
-        sources = self.client.list(
-            ConfigMap,
-            namespace=self.config.controller_namespace,
-            labels={C.RUNTIME_IMAGE_LABEL: "true"},
-        )
-        data = {}
-        for src in sources:
-            for display_name, meta_json in sorted(src.data.items()):
-                key = _format_key_name(display_name)
-                try:
-                    meta = json.loads(meta_json)
-                except ValueError:
-                    continue
-                data[key] = json.dumps(meta, sort_keys=True)
-        if not data:
-            # last runtime-image source removed: prune the per-ns catalog so
-            # notebooks stop offering images that no longer exist
-            try:
-                self.client.delete(
-                    ConfigMap, nb.metadata.namespace, RUNTIME_IMAGES_CONFIGMAP
-                )
-            except NotFoundError:
-                pass
-            return
-        try:
-            cur = self.client.get(
-                ConfigMap, nb.metadata.namespace, RUNTIME_IMAGES_CONFIGMAP
-            )
-            if cur.data != data:
-                cur.data = data
-                self.client.update(cur)
-        except NotFoundError:
-            cm = ConfigMap()
-            cm.metadata.name = RUNTIME_IMAGES_CONFIGMAP
-            cm.metadata.namespace = nb.metadata.namespace
-            cm.data = data
-            self._create(cm)
+        sync_runtime_images(self.client, self.config, nb.metadata.namespace)
 
     # ================= pipeline RBAC + Elyra =================
 
@@ -415,47 +379,13 @@ class TPUWorkbenchReconciler:
         self._create(rb)
 
     def reconcile_elyra_secret(self, nb: Notebook) -> None:
-        """Render the Elyra runtime config from the pipeline server's
-        connection secret (DSPA-extraction analog, reference
-        notebook_dspa_secret.go:189-371)."""
-        try:
-            src = self.client.get(
-                Secret, self.config.controller_namespace, PIPELINE_SERVER_SECRET
-            )
-        except NotFoundError:
-            return
-        cfg = {
-            "display_name": "Data Science Pipeline",
-            "schema_name": "kfp",
-            "metadata": {
-                "tags": [],
-                "display_name": "Data Science Pipeline",
-                "engine": "Argo",
-                "auth_type": "KUBERNETES_SERVICE_ACCOUNT_TOKEN",
-                "api_endpoint": src.string_data.get("api_endpoint", ""),
-                "public_api_endpoint": src.string_data.get("public_api_endpoint", ""),
-                "cos_auth_type": "KUBERNETES_SECRET",
-                "cos_endpoint": src.string_data.get("cos_endpoint", ""),
-                "cos_bucket": src.string_data.get("cos_bucket", ""),
-                "cos_secret": ELYRA_SECRET_NAME,
-                "cos_username": src.string_data.get("cos_username", ""),
-                "cos_password": src.string_data.get("cos_password", ""),
-                "runtime_type": "KUBEFLOW_PIPELINES",
-            },
-        }
-        desired = {"odh_dsp.json": json.dumps(cfg, sort_keys=True)}
-        try:
-            cur = self.client.get(Secret, nb.metadata.namespace, ELYRA_SECRET_NAME)
-            if cur.string_data != desired:
-                cur.string_data = desired
-                self.client.update(cur)
-        except NotFoundError:
-            secret = Secret()
-            secret.metadata.name = ELYRA_SECRET_NAME
-            secret.metadata.namespace = nb.metadata.namespace
-            secret.string_data = desired
-            secret.type = "Opaque"
-            self._create(secret)
+        """Render the Elyra runtime config Secret (`ds-pipeline-config`,
+        odh_dsp.json). Extraction order mirrors the reference
+        (notebook_dspa_secret.go:106-148,189-371): the namespace's DSPA CR
+        (endpoints + object-storage creds from its S3 secret, public endpoint
+        from the Gateway hostname) first, the flat `pipeline-server-config`
+        Secret as the no-DSPA fallback."""
+        sync_elyra_secret(self.client, self.config, nb.metadata.namespace)
 
     # ================= routing =================
 
@@ -662,3 +592,183 @@ def _format_key_name(display_name: str) -> str:
     :174-182)."""
     sanitized = display_name.lower().replace(" ", "_").replace("/", "_")
     return f"{sanitized}.json"
+
+
+# ---------------------------------------------------------------------------
+# Shared sync helpers: the webhook syncs these at admission (so a notebook's
+# FIRST pod already mounts them — reference notebook_webhook.go:400-429) and
+# the extension controller keeps them fresh afterwards.
+# ---------------------------------------------------------------------------
+
+
+def sync_runtime_images(client, config, namespace: str) -> bool:
+    """Build/refresh the per-namespace `pipeline-runtime-images` ConfigMap
+    from runtime-image sources in the controller namespace (ImageStream-list
+    analog, reference notebook_runtime.go:43-152). Returns True when the
+    catalog exists after the sync."""
+    sources = client.list(
+        ConfigMap,
+        namespace=config.controller_namespace,
+        labels={C.RUNTIME_IMAGE_LABEL: "true"},
+    )
+    data = {}
+    for src in sources:
+        for display_name, meta_json in sorted(src.data.items()):
+            key = _format_key_name(display_name)
+            try:
+                meta = json.loads(meta_json)
+            except ValueError:
+                continue
+            data[key] = json.dumps(meta, sort_keys=True)
+    if not data:
+        # last runtime-image source removed: prune the per-ns catalog so
+        # notebooks stop offering images that no longer exist
+        try:
+            client.delete(ConfigMap, namespace, RUNTIME_IMAGES_CONFIGMAP)
+        except NotFoundError:
+            pass
+        return False
+    try:
+        cur = client.get(ConfigMap, namespace, RUNTIME_IMAGES_CONFIGMAP)
+        if cur.data != data:
+            cur.data = data
+            client.update(cur)
+    except NotFoundError:
+        cm = ConfigMap()
+        cm.metadata.name = RUNTIME_IMAGES_CONFIGMAP
+        cm.metadata.namespace = namespace
+        cm.data = data
+        try:
+            client.create(cm)
+        except AlreadyExistsError:
+            pass
+    return True
+
+
+def _gateway_public_hostname(client, config) -> str:
+    """Public endpoint hostname: the data-science Gateway's listener hostname
+    (reference getHostnameForPublicEndpoint, notebook_dspa_secret.go:106-148;
+    its OpenShift-Route fallback maps here to the flat secret fallback in
+    sync_elyra_secret)."""
+    from ..api.gateway import Gateway
+
+    try:
+        gw = client.get(Gateway, config.gateway_namespace, config.gateway_name)
+    except NotFoundError:
+        return ""
+    for listener in gw.spec.listeners:
+        if listener.hostname:
+            return listener.hostname
+    return ""
+
+
+def sync_elyra_secret(client, config, namespace: str) -> bool:
+    """Render the `ds-pipeline-config` Secret (Elyra KFP runtime config,
+    odh_dsp.json). DSPA-first, exactly like the reference
+    (notebook_dspa_secret.go:189-371): endpoints derive from the namespace's
+    DSPA CR, object-storage credentials from its S3 secret, the public
+    endpoint from the Gateway hostname; without a DSPA, the flat
+    `pipeline-server-config` Secret in the controller namespace supplies the
+    fields. Returns True when the Secret exists after the sync."""
+    from ..api.dspa import DSPA_NAME, DataSciencePipelinesApplication
+
+    owner = None
+    meta: Optional[dict] = None
+    try:
+        dspa = client.get(DataSciencePipelinesApplication, namespace, DSPA_NAME)
+    except NotFoundError:
+        dspa = None
+    if dspa is not None:
+        owner = dspa
+        cos_endpoint = cos_bucket = cos_user = cos_password = ""
+        storage = dspa.spec.object_storage
+        ext = storage.external_storage if storage else None
+        if ext is not None:
+            scheme = ext.scheme or "https"
+            cos_endpoint = f"{scheme}://{ext.host}" if ext.host else ""
+            cos_bucket = ext.bucket
+            creds = ext.s3_credentials_secret
+            if creds is not None and creds.secret_name:
+                try:
+                    s3 = client.get(Secret, namespace, creds.secret_name)
+                    blob = dict(s3.string_data or {})
+                    cos_user = blob.get(creds.access_key or "accesskey", "")
+                    cos_password = blob.get(creds.secret_key or "secretkey", "")
+                except NotFoundError:
+                    pass
+        api_endpoint = (
+            f"https://ds-pipeline-{DSPA_NAME}.{namespace}.svc."
+            f"{config.cluster_domain}:8443"
+        )
+        hostname = _gateway_public_hostname(client, config)
+        public_api_endpoint = (
+            f"https://{hostname}/pipeline/{namespace}/{DSPA_NAME}" if hostname else ""
+        )
+        if not public_api_endpoint:
+            # Route-fallback analog: the flat secret may carry an externally
+            # published endpoint when no Gateway hostname is set
+            try:
+                flat = client.get(
+                    Secret, config.controller_namespace, PIPELINE_SERVER_SECRET
+                )
+                public_api_endpoint = flat.string_data.get("public_api_endpoint", "")
+            except NotFoundError:
+                pass
+        meta = {
+            "api_endpoint": api_endpoint,
+            "public_api_endpoint": public_api_endpoint,
+            "cos_endpoint": cos_endpoint,
+            "cos_bucket": cos_bucket,
+            "cos_username": cos_user,
+            "cos_password": cos_password,
+        }
+    else:
+        try:
+            src = client.get(
+                Secret, config.controller_namespace, PIPELINE_SERVER_SECRET
+            )
+        except NotFoundError:
+            return False
+        meta = {
+            "api_endpoint": src.string_data.get("api_endpoint", ""),
+            "public_api_endpoint": src.string_data.get("public_api_endpoint", ""),
+            "cos_endpoint": src.string_data.get("cos_endpoint", ""),
+            "cos_bucket": src.string_data.get("cos_bucket", ""),
+            "cos_username": src.string_data.get("cos_username", ""),
+            "cos_password": src.string_data.get("cos_password", ""),
+        }
+
+    cfg = {
+        "display_name": "Data Science Pipeline",
+        "schema_name": "kfp",
+        "metadata": {
+            "tags": [],
+            "display_name": "Data Science Pipeline",
+            "engine": "Argo",
+            "auth_type": "KUBERNETES_SERVICE_ACCOUNT_TOKEN",
+            "cos_auth_type": "KUBERNETES_SECRET",
+            "cos_secret": ELYRA_SECRET_NAME,
+            "runtime_type": "KUBEFLOW_PIPELINES",
+            **meta,
+        },
+    }
+    desired = {"odh_dsp.json": json.dumps(cfg, sort_keys=True)}
+    try:
+        cur = client.get(Secret, namespace, ELYRA_SECRET_NAME)
+        if cur.string_data != desired:
+            cur.string_data = desired
+            client.update(cur)
+    except NotFoundError:
+        secret = Secret()
+        secret.metadata.name = ELYRA_SECRET_NAME
+        secret.metadata.namespace = namespace
+        secret.string_data = desired
+        secret.type = "Opaque"
+        if owner is not None:
+            # owned by the DSPA, as the reference's secret is (:280-371)
+            secret.set_owner(owner, controller=False)
+        try:
+            client.create(secret)
+        except AlreadyExistsError:
+            pass
+    return True

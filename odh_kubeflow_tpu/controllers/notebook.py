@@ -39,6 +39,7 @@ from ..apimachinery import (
     Condition,
     NotFoundError,
     now_rfc3339,
+    sanitize_name,
 )
 from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
@@ -51,8 +52,41 @@ from .metrics import NotebookMetrics
 log = logging.getLogger(__name__)
 
 
+def statefulset_name(nb_name: str) -> str:
+    """Deterministic 52-char clamp (truncate + hash) where the reference
+    switches to generateName `nb-` past 52 chars (notebook_controller.go:
+    58-59): pod ordinals append `-N` and the name must stay a valid DNS
+    label — multi-host coordinator addressing depends on it. Deterministic
+    (unlike generateName) so level-triggered reconciles converge."""
+    return sanitize_name(nb_name, max_len=52)
+
+
 def hosts_service_name(nb_name: str) -> str:
-    return f"{nb_name}-hosts"
+    # a DNS label itself (pod DNS is {pod}.{svc}.{ns}.svc...): clamp at 63
+    return sanitize_name(f"{nb_name}-hosts", max_len=63)
+
+
+def per_ordinal_probe_urls(
+    client, config, nb: Notebook, hosts: int, path: str
+) -> List[str]:
+    """One agent endpoint per ordinal over per-pod DNS — shared by the
+    culler's /tpu/utilization probe and the readiness gate's /tpu/readiness
+    probe so addressing fixes land once. Rides the StatefulSet's ACTUAL
+    serviceName (immutable in real k8s: an STS created before a rename keeps
+    its old headless svc), falling back to the derived name."""
+    svc = hosts_service_name(nb.metadata.name)
+    sts_name = statefulset_name(nb.metadata.name)
+    try:
+        sts = client.get(StatefulSet, nb.metadata.namespace, sts_name)
+        if sts.spec.service_name:
+            svc = sts.spec.service_name
+    except NotFoundError:
+        pass
+    return [
+        f"http://{sts_name}-{i}.{svc}.{nb.metadata.namespace}.svc."
+        f"{config.cluster_domain}:{config.probe_port}{path}"
+        for i in range(hosts)
+    ]
 
 
 class NotebookReconciler:
@@ -93,7 +127,7 @@ class NotebookReconciler:
 
     def generate_statefulset(self, nb: Notebook, shape: Optional[SliceShape]) -> StatefulSet:
         sts = StatefulSet()
-        sts.metadata.name = nb.metadata.name
+        sts.metadata.name = statefulset_name(nb.metadata.name)
         sts.metadata.namespace = nb.metadata.namespace
         sts.metadata.labels = {C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
 
@@ -164,7 +198,7 @@ class NotebookReconciler:
             existing = {e.name for e in container.env}
             for ev in tpu_env(
                 shape,
-                nb.metadata.name,
+                statefulset_name(nb.metadata.name),  # pod DNS rides the STS name
                 svc,
                 nb.metadata.namespace,
                 self.config.cluster_domain,
@@ -288,7 +322,9 @@ class NotebookReconciler:
 
     def _update_status(self, nb: Notebook, shape: Optional[SliceShape]) -> None:
         try:
-            sts = self.client.get(StatefulSet, nb.metadata.namespace, nb.metadata.name)
+            sts = self.client.get(
+                StatefulSet, nb.metadata.namespace, statefulset_name(nb.metadata.name)
+            )
         except NotFoundError:
             return
         pods = [
@@ -311,7 +347,12 @@ class NotebookReconciler:
 
         # mirror pod 0 (PodCondToNotebookCond analog, :376-415)
         pod0 = next(
-            (p for p in pods if p.metadata.name == f"{nb.metadata.name}-0"), None
+            (
+                p
+                for p in pods
+                if p.metadata.name == f"{statefulset_name(nb.metadata.name)}-0"
+            ),
+            None,
         )
         if pod0 is not None:
             status.conditions = [

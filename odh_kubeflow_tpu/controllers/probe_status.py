@@ -26,7 +26,6 @@ import logging
 import time
 from typing import List, Optional, Tuple
 
-from ..api.apps import StatefulSet
 from ..api.core import Pod
 from ..api.notebook import Notebook, TPUStatus
 from ..apimachinery import NotFoundError, now_rfc3339, parse_time
@@ -38,7 +37,7 @@ from . import constants as C
 from .config import Config
 from .culling import HTTPGet, _default_http_get
 from .metrics import NotebookMetrics
-from .notebook import hosts_service_name
+from .notebook import per_ordinal_probe_urls
 
 log = logging.getLogger(__name__)
 
@@ -63,20 +62,11 @@ class ProbeStatusController:
     # ---------- probing ----------
 
     def readiness_urls(self, nb: Notebook, hosts: int) -> List[str]:
-        """One /tpu/readiness endpoint per ordinal, over per-pod DNS (same
-        address scheme as the culler's utilization probe)."""
-        svc = hosts_service_name(nb.metadata.name)
-        try:
-            sts = self.client.get(StatefulSet, nb.metadata.namespace, nb.metadata.name)
-            if sts.spec.service_name:
-                svc = sts.spec.service_name
-        except NotFoundError:
-            pass
-        return [
-            f"http://{nb.metadata.name}-{i}.{svc}.{nb.metadata.namespace}.svc."
-            f"{self.config.cluster_domain}:{self.config.probe_port}/tpu/readiness"
-            for i in range(hosts)
-        ]
+        """One /tpu/readiness endpoint per ordinal (shared addressing with
+        the culler's utilization probe: per_ordinal_probe_urls)."""
+        return per_ordinal_probe_urls(
+            self.client, self.config, nb, hosts, "/tpu/readiness"
+        )
 
     PROBE_TIMEOUT_S = 2.0
 

@@ -59,6 +59,12 @@ AUTH_PROXY_PORT = 8443
 # unmount can never collide with a user-defined volume.
 FEAST_VOLUME = "odh-feast-config"
 FEAST_MOUNT_PATH = "/opt/app-root/src/feast-config"
+# pipeline-runtimes catalog mount (reference notebook_runtime.go:216-285)
+RUNTIME_IMAGES_VOLUME = "pipeline-runtime-images"
+RUNTIME_IMAGES_MOUNT_PATH = "/opt/app-root/pipeline-runtimes/"
+# Elyra runtime config mount (reference notebook_dspa_secret.go:375-449)
+ELYRA_VOLUME = "elyra-dsp-details"
+ELYRA_MOUNT_PATH = "/opt/app-root/runtimes"
 
 
 class NotebookWebhook:
@@ -94,6 +100,9 @@ class NotebookWebhook:
             self.validate_tpu(nb, span)
             self.set_container_image_from_catalog(nb, span)
             self.check_and_mount_ca_bundle(nb)
+            self.sync_and_mount_runtime_images(nb)
+            if self.config.set_pipeline_secret:
+                self.sync_and_mount_elyra_config(nb)
             if nb.metadata.labels.get(C.FEAST_LABEL) == "true":
                 self.mount_feast_config(nb)
             else:
@@ -163,6 +172,73 @@ class NotebookWebhook:
         """Label removed ⇒ volume + mounts go away (reference :120-146)."""
         self._strip_legacy_feast_volume(nb)
         self._remove_volume_and_mounts(nb.spec.template.spec, FEAST_VOLUME)
+
+    def _mount_into_all_containers(
+        self, nb: Notebook, volume: Volume, mount_path: str
+    ) -> None:
+        """Idempotently add a volume + a mount in EVERY container (both
+        pipeline mounts apply to all containers in the reference:
+        notebook_runtime.go:216-285, notebook_dspa_secret.go:375-449)."""
+        podspec = nb.spec.template.spec
+        if podspec.volume(volume.name) is None:
+            podspec.volumes.append(volume)
+        for container in podspec.containers:
+            if not any(m.name == volume.name for m in container.volume_mounts):
+                container.volume_mounts.append(
+                    VolumeMount(name=volume.name, mount_path=mount_path, read_only=True)
+                )
+
+    def sync_and_mount_runtime_images(self, nb: Notebook) -> None:
+        """Sync the per-namespace `pipeline-runtime-images` catalog, then
+        mount it at the pipeline-runtimes path in all containers (reference
+        notebook_webhook.go:400-410 + notebook_runtime.go:216-285). Syncing
+        at admission means the FIRST pod already sees its runtimes — no
+        blocked-update cycle later."""
+        from .extension import RUNTIME_IMAGES_CONFIGMAP, sync_runtime_images
+
+        try:
+            have_catalog = sync_runtime_images(
+                self.client, self.config, nb.metadata.namespace
+            )
+        except Exception as e:  # sync problems must not reject the write
+            log.warning("runtime-images sync failed for %s: %r", nb.key(), e)
+            have_catalog = nb.spec.template.spec.volume(RUNTIME_IMAGES_VOLUME) is not None
+        if not have_catalog:
+            self._remove_volume_and_mounts(
+                nb.spec.template.spec, RUNTIME_IMAGES_VOLUME
+            )
+            return
+        self._mount_into_all_containers(
+            nb,
+            Volume(
+                name=RUNTIME_IMAGES_VOLUME,
+                config_map={"name": RUNTIME_IMAGES_CONFIGMAP},
+            ),
+            RUNTIME_IMAGES_MOUNT_PATH,
+        )
+
+    def sync_and_mount_elyra_config(self, nb: Notebook) -> None:
+        """Sync the `ds-pipeline-config` Secret (DSPA-derived Elyra runtime
+        config), then mount it at /opt/app-root/runtimes in all containers
+        (reference notebook_webhook.go:413-429 + notebook_dspa_secret.go
+        :375-449)."""
+        from .extension import ELYRA_SECRET_NAME, sync_elyra_secret
+
+        try:
+            have_secret = sync_elyra_secret(
+                self.client, self.config, nb.metadata.namespace
+            )
+        except Exception as e:
+            log.warning("elyra-config sync failed for %s: %r", nb.key(), e)
+            have_secret = nb.spec.template.spec.volume(ELYRA_VOLUME) is not None
+        if not have_secret:
+            self._remove_volume_and_mounts(nb.spec.template.spec, ELYRA_VOLUME)
+            return
+        self._mount_into_all_containers(
+            nb,
+            Volume(name=ELYRA_VOLUME, secret={"secretName": ELYRA_SECRET_NAME}),
+            ELYRA_MOUNT_PATH,
+        )
 
     def inject_reconciliation_lock(self, nb: Notebook) -> None:
         """The webhook<->extension-controller handshake: replicas stay 0 until

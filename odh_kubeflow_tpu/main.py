@@ -116,13 +116,16 @@ def main() -> None:  # pragma: no cover - thin CLI shell
         cluster = SimCluster().start()
         mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
         log.info("tpu-notebook-controller running (in-process cluster)")
-    mgr.start()
     # /metrics on :8080, /healthz + /readyz on :8081 (reference
-    # notebook-controller/main.go:125-133; deploy probes point here)
+    # notebook-controller/main.go:125-133; deploy probes point here).
+    # MUST bind before start(): with leader election, start() blocks waiting
+    # out the old lease, and a standby that doesn't answer its liveness
+    # probe would be killed into CrashLoopBackOff
     endpoints = mgr.serve_endpoints(
         metrics_port=int(os.environ.get("METRICS_PORT", "8080")),
         health_port=int(os.environ.get("HEALTH_PORT", "8081")),
     )
+    mgr.start()
     try:
         import signal
         import threading

@@ -1,0 +1,36 @@
+"""Standalone probe agent entrypoint: `python -m odh_kubeflow_tpu.probe`.
+
+Runs next to the notebook process in the workbench image, serving
+/tpu/readiness + /tpu/utilization (+ Jupyter-compatible stubs when no real
+Jupyter answers) on NB_PROBE_PORT. Duty cycle is measured (libtpu metrics
+scrape + runtime-state sampling) — see JaxTPUMonitor.
+"""
+import logging
+import os
+import signal
+import threading
+
+from .agent import NotebookAgent
+
+logging.basicConfig(level=logging.INFO)
+log = logging.getLogger("odh_kubeflow_tpu.probe")
+
+
+def main() -> None:
+    port = int(os.environ.get("NB_PROBE_PORT", "8889"))
+    agent = NotebookAgent()
+    host, bound_port, close = agent.serve(host="0.0.0.0", port=port)
+    log.info("probe agent serving on %s:%s", host, bound_port)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    close()
+    # a sidecar must exit promptly on SIGTERM or it delays pod teardown —
+    # the TPU runtime may hold non-daemon threads that would block a clean
+    # interpreter exit
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

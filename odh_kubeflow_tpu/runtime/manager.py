@@ -102,15 +102,28 @@ class LeaderElector:
             return False
 
     def _run(self) -> None:
+        last_renew = time.monotonic()
         while not self._stop.is_set():
             try:
                 acquired = self._try_acquire()
+                if acquired:
+                    last_renew = time.monotonic()
             except Exception as e:
                 # transient API/transport errors must not kill the elector
                 # thread (a dead elector with is_leader still set is silent
-                # split-brain); treat the tick as not-acquired and retry
-                log.warning("leader election tick failed: %r", e)
-                acquired = False
+                # split-brain). While the lease we hold is still within its
+                # duration, one failed renew tick is NOT lease loss — stand
+                # down only when renewal keeps failing past the deadline.
+                held = (
+                    self.is_leader.is_set()
+                    and time.monotonic() - last_renew < self.lease_duration
+                )
+                log.warning(
+                    "leader election tick failed (%s): %r",
+                    "lease still held" if held else "standing down",
+                    e,
+                )
+                acquired = held
             was_leader = self.is_leader.is_set()
             if acquired:
                 self.is_leader.set()

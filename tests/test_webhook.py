@@ -1,8 +1,10 @@
 """Mutating webhook: lock injection, TPU validation, image catalog, CA
 bundle, auth sidecar, update-blocking (the reference's subtlest behavior)."""
+import json
+
 import pytest
 
-from odh_kubeflow_tpu.api.core import ConfigMap, Container
+from odh_kubeflow_tpu.api.core import ConfigMap, Container, Secret
 from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
 from odh_kubeflow_tpu.apimachinery import AdmissionDeniedError
 from odh_kubeflow_tpu.cluster import Client, Store
@@ -329,3 +331,165 @@ def test_feast_legacy_optional_volume_keeps_optionality(env):
     created = client.create(nb)
     vol = created.spec.template.spec.volume(FEAST_VOLUME)
     assert vol is not None and vol.config_map.get("optional") is True
+
+
+# ---- pipeline runtime-images + Elyra mounts (VERDICT-r1 next #6) ----
+
+
+def _mk_runtime_source(ns):
+    cm = ConfigMap()
+    cm.metadata.name = "runtime-sources"
+    cm.metadata.namespace = ns
+    cm.metadata.labels = {C.RUNTIME_IMAGE_LABEL: "true"}
+    cm.data = {
+        "Tensorflow 2.x": json.dumps({"display_name": "Tensorflow 2.x", "metadata": {"image_name": "tf:2"}})
+    }
+    return cm
+
+
+def test_webhook_syncs_and_mounts_runtime_images():
+    """reference notebook_webhook.go:400-410 + notebook_runtime.go:216-285:
+    admission syncs the catalog into the user ns and mounts it at the
+    pipeline-runtimes path in ALL containers."""
+    from odh_kubeflow_tpu.controllers.extension import RUNTIME_IMAGES_CONFIGMAP
+    from odh_kubeflow_tpu.controllers.webhook import (
+        RUNTIME_IMAGES_MOUNT_PATH,
+        RUNTIME_IMAGES_VOLUME,
+    )
+
+    store = Store()
+    config = Config(controller_namespace="ctrl-ns")
+    client = Client(store)
+    client.create(_mk_runtime_source("ctrl-ns"))
+    NotebookWebhook(client, config).register(store)
+
+    nb = mk_nb("pipe")
+    nb.spec.template.spec.containers.append(Container(name="sidecar", image="s:1"))
+    out = client.create(nb)
+
+    catalog = client.get(ConfigMap, "user", RUNTIME_IMAGES_CONFIGMAP)
+    assert "tensorflow_2.x.json" in catalog.data
+    spec = out.spec.template.spec
+    vol = spec.volume(RUNTIME_IMAGES_VOLUME)
+    assert vol is not None and vol.config_map == {"name": RUNTIME_IMAGES_CONFIGMAP}
+    for c in spec.containers:
+        mounts = {m.name: m for m in c.volume_mounts}
+        assert RUNTIME_IMAGES_VOLUME in mounts
+        assert mounts[RUNTIME_IMAGES_VOLUME].mount_path == RUNTIME_IMAGES_MOUNT_PATH
+        assert mounts[RUNTIME_IMAGES_VOLUME].read_only is True
+
+
+def test_webhook_no_catalog_no_mount():
+    store = Store()
+    client = Client(store)
+    NotebookWebhook(client, Config(controller_namespace="ctrl-ns")).register(store)
+    out = client.create(mk_nb("bare"))
+    from odh_kubeflow_tpu.controllers.webhook import RUNTIME_IMAGES_VOLUME
+
+    assert out.spec.template.spec.volume(RUNTIME_IMAGES_VOLUME) is None
+
+
+def test_webhook_mounts_elyra_config_from_dspa():
+    """DSPA-shaped extraction (reference notebook_dspa_secret.go:106-148,
+    189-273): endpoints from the DSPA CR, creds from its S3 secret, public
+    endpoint from the Gateway hostname; secret mounted at
+    /opt/app-root/runtimes in all containers and owned by the DSPA."""
+    from odh_kubeflow_tpu.api.dspa import (
+        DataSciencePipelinesApplication,
+        DSPASpec,
+        ExternalStorage,
+        ObjectStorage,
+        S3CredentialsSecret,
+    )
+    from odh_kubeflow_tpu.api.gateway import (
+        Gateway,
+        GatewayListener,
+        GatewaySpec,
+    )
+    from odh_kubeflow_tpu.controllers.extension import ELYRA_SECRET_NAME
+    from odh_kubeflow_tpu.controllers.webhook import ELYRA_MOUNT_PATH, ELYRA_VOLUME
+
+    store = Store()
+    config = Config(
+        controller_namespace="ctrl-ns",
+        set_pipeline_secret=True,
+        gateway_name="data-science-gateway",
+        gateway_namespace="gw-ns",
+    )
+    client = Client(store)
+
+    s3 = Secret()
+    s3.metadata.name = "minio-creds"
+    s3.metadata.namespace = "user"
+    s3.string_data = {"accesskey": "AKIA", "secretkey": "hunter2"}
+    client.create(s3)
+
+    dspa = DataSciencePipelinesApplication()
+    dspa.metadata.name = "dspa"
+    dspa.metadata.namespace = "user"
+    dspa.spec = DSPASpec(
+        object_storage=ObjectStorage(
+            external_storage=ExternalStorage(
+                host="minio.user.svc:9000",
+                scheme="http",
+                bucket="pipelines",
+                s3_credentials_secret=S3CredentialsSecret(
+                    secret_name="minio-creds",
+                    access_key="accesskey",
+                    secret_key="secretkey",
+                ),
+            )
+        )
+    )
+    client.create(dspa)
+
+    gw = Gateway()
+    gw.metadata.name = "data-science-gateway"
+    gw.metadata.namespace = "gw-ns"
+    gw.spec = GatewaySpec(listeners=[GatewayListener(name="https", hostname="ds.example.com")])
+    client.create(gw)
+
+    NotebookWebhook(client, config).register(store)
+    out = client.create(mk_nb("ds"))
+
+    secret = client.get(Secret, "user", ELYRA_SECRET_NAME)
+    cfg = json.loads(secret.string_data["odh_dsp.json"])
+    md = cfg["metadata"]
+    assert md["api_endpoint"] == "https://ds-pipeline-dspa.user.svc.cluster.local:8443"
+    assert md["public_api_endpoint"] == "https://ds.example.com/pipeline/user/dspa"
+    assert md["cos_endpoint"] == "http://minio.user.svc:9000"
+    assert md["cos_bucket"] == "pipelines"
+    assert md["cos_username"] == "AKIA" and md["cos_password"] == "hunter2"
+    assert any(r.name == "dspa" for r in secret.metadata.owner_references)
+
+    spec = out.spec.template.spec
+    vol = spec.volume(ELYRA_VOLUME)
+    assert vol is not None and vol.secret == {"secretName": ELYRA_SECRET_NAME}
+    assert all(
+        any(m.name == ELYRA_VOLUME and m.mount_path == ELYRA_MOUNT_PATH for m in c.volume_mounts)
+        for c in spec.containers
+    )
+
+
+def test_elyra_flat_secret_fallback_still_works():
+    """No DSPA in the namespace: the flat pipeline-server-config path
+    (round-1 behavior) still renders the secret."""
+    from odh_kubeflow_tpu.controllers.extension import (
+        ELYRA_SECRET_NAME,
+        PIPELINE_SERVER_SECRET,
+    )
+
+    store = Store()
+    config = Config(controller_namespace="ctrl-ns", set_pipeline_secret=True)
+    client = Client(store)
+    flat = Secret()
+    flat.metadata.name = PIPELINE_SERVER_SECRET
+    flat.metadata.namespace = "ctrl-ns"
+    flat.string_data = {"api_endpoint": "https://flat:8443", "cos_bucket": "b"}
+    client.create(flat)
+    NotebookWebhook(client, config).register(store)
+    client.create(mk_nb("flat"))
+    secret = client.get(Secret, "user", ELYRA_SECRET_NAME)
+    cfg = json.loads(secret.string_data["odh_dsp.json"])
+    assert cfg["metadata"]["api_endpoint"] == "https://flat:8443"
+    assert cfg["metadata"]["cos_bucket"] == "b"
