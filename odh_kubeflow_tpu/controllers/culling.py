@@ -22,7 +22,6 @@ import logging
 import time
 from typing import Callable, List, Optional, Tuple
 
-from ..api.apps import StatefulSet
 from ..api.core import Pod
 from ..api.notebook import Notebook
 from ..apimachinery import NotFoundError, now_rfc3339, parse_time, rfc3339
@@ -33,7 +32,7 @@ from ..tpu import plan_slice
 from . import constants as C
 from .config import Config
 from .metrics import NotebookMetrics
-from .notebook import hosts_service_name, per_ordinal_probe_urls, statefulset_name
+from .notebook import per_ordinal_probe_urls, statefulset_name
 
 log = logging.getLogger(__name__)
 

@@ -755,8 +755,16 @@ def sync_elyra_secret(client, config, namespace: str) -> bool:
     desired = {"odh_dsp.json": json.dumps(cfg, sort_keys=True)}
     try:
         cur = client.get(Secret, namespace, ELYRA_SECRET_NAME)
+        changed = False
         if cur.string_data != desired:
             cur.string_data = desired
+            changed = True
+        if owner is not None and not cur.owned_by(owner):
+            # a DSPA that appeared after the secret was first rendered must
+            # still own it (GC on DSPA deletion — reference :280-371)
+            cur.set_owner(owner, controller=False)
+            changed = True
+        if changed:
             client.update(cur)
     except NotFoundError:
         secret = Secret()

@@ -174,7 +174,24 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, positions=None):
     if positions is None:
         positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, tokens.shape)
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    table = params["embed"].astype(cfg.dtype)
+    if mesh is not None:
+        # explicitly all-gather the (stored tp-sharded) table before the
+        # gather: a gather whose operand is d-sharded while its output wants
+        # batch/seq sharding trips XLA's "involuntary full rematerialization"
+        # path; with a replicated operand and sharded indices the gather is
+        # purely local and the output is born in the residual's sharding
+        table = lax.with_sharding_constraint(
+            table, jax.sharding.NamedSharding(mesh, logical_to_spec((None, None), mesh))
+        )
+    x = table[tokens]
+    if mesh is not None:
+        x = lax.with_sharding_constraint(
+            x,
+            jax.sharding.NamedSharding(
+                mesh, logical_to_spec(("batch", "seq", None), mesh)
+            ),
+        )
 
     body = partial(_layer, positions=positions, cfg=cfg, mesh=mesh)
     if cfg.remat:

@@ -108,6 +108,17 @@ def _flash_kernel(
         ).astype(o_ref.dtype)
 
 
+def _fit_block(block: int, seq: int) -> int:
+    """Largest power-of-two block <= requested that divides seq (power of two
+    FIRST: min(block, seq) alone would hand an irregular short sequence, say
+    20, to the kernel as a tile-misaligned block and fail Mosaic lowering)."""
+    block = min(block, seq)
+    block = 1 << (block.bit_length() - 1)
+    while block > 1 and seq % block:
+        block //= 2
+    return block
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
 )
@@ -116,25 +127,68 @@ def flash_attention(
     k,
     v,
     causal: bool = True,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: bool = False,
 ):
-    """Fused attention. q/k/v: (batch, seq, heads, head_dim), seq divisible by
-    the block sizes. Dispatches to the pallas kernel on TPU (or interpret=True
-    anywhere); otherwise the XLA reference path."""
+    """Fused attention. q/k/v: (batch, seq, heads, head_dim). Dispatches to
+    the pallas kernel on TPU (or interpret=True anywhere); otherwise the XLA
+    reference path.
+
+    Default blocks (512, 1024) are measured on v5e: grid-step overhead falls
+    quadratically with block area, and these keep q/k/v tiles + the f32 carry
+    comfortably inside VMEM (q 128K + k/v 256K×2(double-buffer) + acc 256K).
+    Blocks clamp to the largest power-of-two divisor of the sequence, so
+    short sequences still hit the kernel."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     on_tpu = jax.default_backend() == "tpu"
     use_kernel = (
         _HAVE_PALLAS
         and (on_tpu or interpret)
         and sq % block_q == 0
         and sk % block_k == 0
+        and block_q >= 8
+        and block_k >= 128
     )
     if not use_kernel:
         return mha_reference(q, k, v, causal=causal)
+    return _flash_diff(q, k, v, causal, block_q, block_k, interpret)
 
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
+    """Differentiable wrapper: pallas forward, rematerialized backward.
+
+    pallas_call has no JVP rule, so training would fail at value_and_grad
+    without this. The backward re-derives gradients from the reference math;
+    note it DOES materialize the O(s²) score matrices in HBM during the
+    backward pass (multi-consumer residuals defeat XLA's fusion), so very
+    long single-chip sequences train via sequence parallelism (ring
+    attention over `sp`, which shards s) until the blockwise pallas
+    backward kernel lands. The forward remains O(s) memory either way."""
+    return _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_diff_bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
     # (b, s, h, d) -> (b*h, s, d): one grid row per (batch, head)
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)

@@ -95,6 +95,8 @@ class JaxTPUMonitor(TPUMonitor):
             ports = os.environ.get("TPU_RUNTIME_METRICS_PORTS", "")
             metrics_port = int(ports.split(",")[0]) if ports.strip() else 0
         self._metrics_port = metrics_port
+        self._scrape_cache: Tuple[float, Optional[float]] = (0.0, None)
+        self._scrape_ttl_s = 10.0
         self._sample_period_s = sample_period_s
         self._sampler: Optional[threading.Thread] = None
         self._sampler_stop = threading.Event()
@@ -120,11 +122,17 @@ class JaxTPUMonitor(TPUMonitor):
     def scrape_runtime_duty_cycle(self) -> Optional[float]:
         """Best `*duty_cycle*` gauge from the libtpu metrics endpoint
         (TPU_RUNTIME_METRICS_PORTS, injected by the webhook's TPU env);
-        None when the endpoint is absent/unreachable."""
+        None when the endpoint is absent/unreachable. Success AND failure
+        are cached for a TTL so a dead exporter cannot add its 2 s connect
+        timeout to every /tpu/utilization probe."""
         if not self._metrics_port:
             return None
+        ts, cached = self._scrape_cache
+        if time.time() - ts < self._scrape_ttl_s:
+            return cached
         import urllib.request
 
+        value: Optional[float] = None
         try:
             # 127.0.0.1 explicitly: `localhost` may resolve to ::1 first and
             # the runtime's exporter binds the IPv4 loopback
@@ -132,9 +140,11 @@ class JaxTPUMonitor(TPUMonitor):
                 f"http://127.0.0.1:{self._metrics_port}/metrics", timeout=2
             ) as resp:
                 text = resp.read().decode(errors="replace")
+            value = parse_duty_cycle_metrics(text)
         except Exception:
-            return None
-        return parse_duty_cycle_metrics(text)
+            value = None
+        self._scrape_cache = (time.time(), value)
+        return value
 
     # -- source 2: runtime-state sampling --
 

@@ -114,9 +114,13 @@ class LeaderElector:
                 # split-brain). While the lease we hold is still within its
                 # duration, one failed renew tick is NOT lease loss — stand
                 # down only when renewal keeps failing past the deadline.
+                # stand-down deadline is STRICTLY shorter than the lease: at
+                # lease_duration a rival may legally take the lease, so keeping
+                # leadership that long guarantees an overlap window
+                renew_deadline = max(1.0, self.lease_duration - self.renew_period)
                 held = (
                     self.is_leader.is_set()
-                    and time.monotonic() - last_renew < self.lease_duration
+                    and time.monotonic() - last_renew < renew_deadline
                 )
                 log.warning(
                     "leader election tick failed (%s): %r",
