@@ -49,7 +49,7 @@ def mha_reference(q, k, v, causal: bool = True, q_offset: int = 0, kv_offset: in
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_k: int, causal: bool, sm_scale: float, num_kb: int,
 ):
     """Grid (batch*heads, q_blocks, k_blocks); K/V stream one (block_k, d)
@@ -106,6 +106,16 @@ def _flash_kernel(
         o_ref[0] = (
             acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)
         ).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp per query row — the backward recomputes softmax
+            # probabilities from it without rebuilding the running max/sum.
+            # Lane-broadcast (block_q, 128) like the m/l carries: row stats
+            # live in sublane orientation and Mosaic cannot cheaply
+            # transpose them
+            lse_ref[0] = jnp.broadcast_to(
+                m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30)),
+                lse_ref.shape[1:],
+            )
 
 
 def _fit_block(block: int, seq: int) -> int:
@@ -160,33 +170,38 @@ def flash_attention(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
-    """Differentiable wrapper: pallas forward, rematerialized backward.
+    """Differentiable wrapper: pallas forward AND pallas backward.
 
     pallas_call has no JVP rule, so training would fail at value_and_grad
-    without this. The backward re-derives gradients from the reference math;
-    note it DOES materialize the O(s²) score matrices in HBM during the
-    backward pass (multi-consumer residuals defeat XLA's fusion), so very
-    long single-chip sequences train via sequence parallelism (ring
-    attention over `sp`, which shards s) until the blockwise pallas
-    backward kernel lands. The forward remains O(s) memory either way."""
+    without this. The forward saves (q, k, v, out, lse); the backward is the
+    blockwise FlashAttention-2 recompute (_flash_backward) — O(s) HBM end to
+    end, so long-context training keeps the flash memory advantage."""
     return _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret)
 
 
 def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward_kernel(
+        q, k, v, causal, block_q, block_k, interpret, with_lse=True
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_diff_bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
-def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret):
+def _compiler_params(pltpu, semantics):
+    try:
+        return pltpu.CompilerParams(dimension_semantics=semantics)
+    except (AttributeError, TypeError):  # pragma: no cover - older pallas API
+        return None
+
+
+def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret, with_lse=False):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     # (b, s, h, d) -> (b*h, s, d): one grid row per (batch, head)
@@ -205,13 +220,22 @@ def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret):
         sm_scale=d**-0.5,
         num_kb=num_kb,
     )
-    try:
-        compiler_params = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")
-        )
-    except (AttributeError, TypeError):  # pragma: no cover - older pallas API
-        compiler_params = None
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
+    if with_lse:
+        # lane-broadcast row stats (see _flash_kernel._emit)
+        out_specs.append(pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32))
+    else:
+        # inference-only forwards must not pay an extra HBM write: a pallas
+        # output cannot be dead-code-eliminated by XLA, so the lse ref is
+        # dropped from the call entirely
+        full = kernel
+
+        def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+            full(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref, acc_ref)
+
+    outs = pl.pallas_call(
         kernel,
         grid=(b * h, sq // block_q, num_kb),
         in_specs=[
@@ -221,14 +245,211 @@ def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),  # m (lane-broadcast)
             pltpu.VMEM((block_q, 128), jnp.float32),  # l (lane-broadcast)
             pltpu.VMEM((block_q, d), jnp.float32),  # acc
         ],
-        compiler_params=compiler_params,
+        compiler_params=_compiler_params(pltpu, ("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    out = outs[0].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    if with_lse:
+        return out, outs[1]  # lse stays in (b*h, sq, 128) kernel layout
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 style): recompute p from q/k + lse, no
+# O(s²) tensor ever stored in HBM. Both kernels share the same recompute:
+#   s  = (q kᵀ)·scale            (block_q, block_k) f32
+#   p  = exp(s − lse)            probabilities, exactly the forward's
+#   dp = do vᵀ                   (block_q, block_k) f32
+#   ds = p ⊙ (dp − delta)·scale  where delta = rowsum(do ⊙ o)
+# dq accumulates over k-blocks; dk/dv accumulate over q-blocks. Contractions
+# over dim 0 (pᵀ·do, dsᵀ·q) are expressed directly in dot_general — Mosaic
+# lowers them without materialized transposes.
+# ---------------------------------------------------------------------------
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qi, ki, block_q, block_k, causal, sm_scale):
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale
+    if causal:
+        qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, :1])
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+    return p, ds
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float, num_kb: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _fold():
+        _, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, block_q, block_k, causal, sm_scale,
+        )
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(ki * block_k < (qi + 1) * block_q)(_fold)
+    else:
+        _fold()
+
+    @pl.when(ki == num_kb - 1)
+    def _emit():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float, num_qb: int,
+):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _fold():
+        p, ds = _recompute_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, block_q, block_k, causal, sm_scale,
+        )
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # q blocks entirely above the diagonal contribute nothing to this
+        # k block (no qpos >= kpos pair)
+        pl.when((qi + 1) * block_q > ki * block_k)(_fold)
+    else:
+        _fold()
+
+    @pl.when(qi == num_qb - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    # smaller blocks than forward: the recompute holds several (bq, bk) f32
+    # intermediates live at once
+    bq = _fit_block(min(block_q, 256), sq)
+    bk = _fit_block(min(block_k, 512), sk)
+    bh = b * h
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, -1, d)
+
+    qt, kt, vt, ot, gt = map(to_bh, (q, k, v, out, g))
+    # delta = rowsum(do ⊙ o), lane-broadcast to the lse layout
+    delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, 128))
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    sm_scale = d**-0.5
+    num_qb = sq // bq
+    num_kb = sk // bk
+
+    row_specs = {
+        "q": pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, i, 0)),
+        "lse": pl.BlockSpec((1, bq, 128), lambda bhi, i, j: (bhi, i, 0)),
+        "kcol": pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, j, 0)),
+    }
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            block_q=bq, block_k=bk, causal=causal, sm_scale=sm_scale, num_kb=num_kb,
+        ),
+        grid=(bh, num_qb, num_kb),
+        in_specs=[
+            row_specs["q"],  # q
+            row_specs["kcol"],  # k
+            row_specs["kcol"],  # v
+            row_specs["q"],  # do
+            row_specs["lse"],  # lse
+            row_specs["lse"],  # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_compiler_params(pltpu, ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse, delta)
+
+    # dkv grid: k blocks outer, q blocks inner (accumulate over q)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            block_q=bq, block_k=bk, causal=causal, sm_scale=sm_scale, num_qb=num_qb,
+        ),
+        grid=(bh, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, j, 0)),  # q
+            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, j, 0)),  # do
+            pl.BlockSpec((1, bq, 128), lambda bhi, i, j: (bhi, j, 0)),  # lse
+            pl.BlockSpec((1, bq, 128), lambda bhi, i, j: (bhi, j, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params(pltpu, ("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, gt, lse, delta)
+
+    def from_bh(x, s):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return from_bh(dq, sq), from_bh(dk, sk), from_bh(dv, sk)

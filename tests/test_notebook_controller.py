@@ -147,7 +147,7 @@ def test_tpu_multihost_v5p32(env):
         lambda: (
             lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
         )(cluster.client.get(Notebook, "user", "train")),
-        msg="mesh ready", timeout=45,
+        msg="mesh ready", timeout=90,
     )
     assert nb.status.tpu.hosts_ready == 4
     assert nb.status.tpu.chips_visible == 16

@@ -101,3 +101,54 @@ def test_flash_kernel_causal_sq_longer_than_sk():
     out = flash_attention(q, k, v, causal=True, interpret=True)
     ref = mha_reference(q, k, v, causal=True)
     assert jnp.max(jnp.abs(out - ref)) < 2e-2
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_matches_reference_grads_interpret(causal):
+    """The blockwise pallas backward (FlashAttention-2 recompute) produces
+    the same gradients as differentiating the reference math — interpret
+    mode, so this guards the kernel on CPU CI."""
+    key = jax.random.PRNGKey(7)
+    b, s, h, d = 1, 256, 2, 128
+    q = jax.random.normal(key, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(8), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(9), (b, s, h, d), jnp.float32)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, causal=causal, block_q=128, block_k=128, interpret=True
+        )
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b_ in zip("qkv", gf, gr):
+        assert jnp.allclose(a, b_, atol=2e-3, rtol=2e-3), name
+
+
+def test_flash_forward_lse_layout_interpret():
+    """The forward's saved lse equals logsumexp of the (scaled, masked)
+    scores, in the lane-broadcast kernel layout."""
+    from odh_kubeflow_tpu.ops.attention import _flash_forward_kernel
+
+    key = jax.random.PRNGKey(3)
+    b, s, h, d = 1, 256, 2, 128
+    q = jax.random.normal(key, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(4), (b, s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(5), (b, s, h, d), jnp.float32)
+    out, lse = _flash_forward_kernel(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True, with_lse=True
+    )
+    assert lse.shape == (b * h, s, 128)
+    # lane-broadcast: all 128 lanes carry the same value
+    assert jnp.allclose(lse[..., 0], lse[..., 64])
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    scores = jnp.einsum("zqd,zkd->zqk", qt, kt) * (d**-0.5)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask[None], scores, -1e30)
+    expected = jax.scipy.special.logsumexp(scores, axis=-1)
+    assert jnp.allclose(lse[..., 0], expected, atol=1e-3, rtol=1e-3)
