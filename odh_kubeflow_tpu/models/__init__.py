@@ -4,20 +4,30 @@ The reference ships no model code (its payload is the user's image); the
 TPU-native build ships a reference workload so a provisioned slice can be
 exercised, benchmarked, and utilization-probed out of the box.
 """
+from .moe import MoEConfig, moe_ffn, route_topk
 from .transformer import (
     TransformerConfig,
     forward,
     init_params,
     loss_fn,
+    make_pp_train_step,
     make_train_step,
     param_specs,
+    pp_forward,
+    pp_param_specs,
+    to_pp_params,
 )
 
 __all__ = [
+    "MoEConfig",
     "TransformerConfig",
     "forward",
     "init_params",
     "loss_fn",
+    "make_pp_train_step",
     "make_train_step",
     "param_specs",
+    "pp_forward",
+    "pp_param_specs",
+    "to_pp_params",
 ]

@@ -1,0 +1,173 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+GShard/Switch-style dense dispatch, TPU-idiomatic: routing produces
+STATIC-SHAPED dispatch/combine tensors (capacity-bounded one-hots) and the
+expert computation is three einsums over an expert-stacked weight pytree.
+Expert weights shard over the `ep` mesh axis (logical axis "expert",
+parallel/mesh.py RULES); with tokens batch-sharded and expert tensors
+ep-sharded, XLA inserts the dispatch/combine all-to-alls from the shardings
+alone — no hand-written collectives, exactly the scaling-book recipe.
+
+Router: top-k (default 2) softmax gating with the Switch load-balance
+auxiliary loss. Capacity: tokens routed beyond `capacity_factor * N/E` per
+expert are dropped (their combine weight is zero) — the standard static-shape
+trade on TPU.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    d_ff: int = 0  # per-expert hidden; 0 = use the dense layer's d_ff
+    router_aux_weight: float = 0.01
+
+
+# expert-stacked params (leading "layers" axis added by the transformer when
+# stacked for scan): expert dim shards over ep, hidden over tp
+MOE_AXES: Dict[str, tuple] = {
+    "router": ("embed", "expert"),
+    "we_gate": ("expert", "embed", "mlp"),
+    "we_up": ("expert", "embed", "mlp"),
+    "we_out": ("expert", "mlp", "embed"),
+}
+
+
+def init_moe_params(rng, d_model: int, cfg: MoEConfig, dtype) -> Dict[str, Any]:
+    e, f = cfg.n_experts, cfg.d_ff
+    keys = jax.random.split(rng, 4)
+
+    def dense(key, shape, fan_in):
+        return (
+            jax.random.truncated_normal(key, -2, 2, shape, jnp.float32)
+            * (1.0 / fan_in) ** 0.5
+        ).astype(dtype)
+
+    return {
+        # router stays f32: tiny, and routing decisions are precision-sensitive
+        "router": dense(keys[0], (d_model, e), d_model).astype(jnp.float32),
+        "we_gate": dense(keys[1], (e, d_model, f), d_model),
+        "we_up": dense(keys[2], (e, d_model, f), d_model),
+        "we_out": dense(keys[3], (e, f, d_model), f),
+    }
+
+
+def route_topk(
+    logits: jnp.ndarray, k: int, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(N, E) router logits -> dispatch (N, E, C) one-hot, combine (N, E, C)
+    weights, and the Switch load-balance aux loss.
+
+    Position within each expert's capacity buffer comes from a cumulative
+    sum over token order — deterministic, static-shaped, oversubscribed
+    tokens drop (combine weight 0)."""
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    # claimed[e] tokens already buffered per expert, updated per routing round
+    claimed = jnp.zeros((e,), jnp.int32)
+    masked = probs
+    for _ in range(k):
+        choice = jnp.argmax(masked, axis=-1)  # (N,)
+        gate = jnp.take_along_axis(masked, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # (N, E)
+        # position of each token in its chosen expert's buffer
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) + claimed[None, :]
+        pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # (N,)
+        keep = pos < capacity
+        pos = jnp.clip(pos, 0, capacity - 1)
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # (N, C)
+        contrib = (
+            onehot.astype(jnp.float32)[:, :, None]
+            * slot[:, None, :]
+            * keep.astype(jnp.float32)[:, None, None]
+        )
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate[:, None, None]
+        claimed = claimed + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+        masked = masked * (1.0 - onehot.astype(jnp.float32))  # next-best expert
+
+    # Switch aux loss: E * sum_e fraction_tokens_e * mean_prob_e
+    top1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e, dtype=jnp.float32)
+    aux = e * jnp.sum(jnp.mean(top1, axis=0) * jnp.mean(probs, axis=0))
+    if k > 1:
+        # renormalize combine weights over the k picks (standard top-2
+        # gating). NOT for k=1: dividing a single pick by its own gate
+        # collapses the weight to 1.0 and kills the router's LM-loss
+        # gradient — Switch top-1 keeps the raw gate precisely so routing
+        # stays differentiable.
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    x: jnp.ndarray,
+    params: Dict[str, Any],
+    cfg: MoEConfig,
+    mesh=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(batch, seq, d) -> (batch, seq, d), plus the router aux loss.
+
+    The three einsums below are where expert parallelism happens: with
+    `expert_in`/`hidden` sharded ("expert", ...) over ep and x sharded over
+    batch, XLA turns dispatch/combine into all-to-alls over ep."""
+    from ..parallel.mesh import logical_to_spec
+
+    b, s, d = x.shape
+    n = b * s
+    e = cfg.n_experts
+    capacity = max(1, int(cfg.capacity_factor * n * cfg.experts_per_token / e))
+
+    def constrain(y, axes):
+        if mesh is None:
+            return y
+        return lax.with_sharding_constraint(
+            y, jax.sharding.NamedSharding(mesh, logical_to_spec(axes, mesh))
+        )
+
+    flat = x.reshape(n, d)
+    logits = flat.astype(jnp.float32) @ params["router"]  # (N, E)
+    dispatch, combine, aux = route_topk(logits, cfg.experts_per_token, capacity)
+
+    # dispatch: (N, E, C) x (N, d) -> (E, C, d)  [all-to-all over ep]
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", dispatch.astype(x.dtype), flat,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    expert_in = constrain(expert_in, ("expert", None, None))
+
+    gate = jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["we_gate"],
+        preferred_element_type=jnp.float32,
+    )
+    up = jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["we_up"],
+        preferred_element_type=jnp.float32,
+    )
+    hidden = (jax.nn.silu(gate) * up).astype(x.dtype)
+    hidden = constrain(hidden, ("expert", None, "mlp"))
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", hidden, params["we_out"],
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    expert_out = constrain(expert_out, ("expert", None, None))
+
+    # combine: (N, E, C) x (E, C, d) -> (N, d)  [all-to-all back]
+    out = jnp.einsum(
+        "nec,ecd->nd", combine.astype(x.dtype), expert_out,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    out = constrain(out.reshape(b, s, d), ("batch", "seq", None))
+    return out, aux.astype(jnp.float32)

@@ -29,6 +29,7 @@ from jax import lax
 
 from ..ops import apply_rope, flash_attention, mha_reference, ring_attention, rms_norm
 from ..parallel.mesh import logical_to_spec
+from .moe import MOE_AXES, MoEConfig, init_moe_params, moe_ffn
 
 
 @dataclass(frozen=True)
@@ -44,11 +45,24 @@ class TransformerConfig:
     remat: bool = True
     use_flash: bool = True
     seq_axis: str = ""  # set to "sp" to run ring attention over that mesh axis
+    # Mixture-of-Experts: set to swap every layer's FFN for routed experts
+    # (models/moe.py; expert weights shard over the `ep` mesh axis)
+    moe: Optional[MoEConfig] = None
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def moe_resolved(self) -> Optional[MoEConfig]:
+        if self.moe is None:
+            return None
+        if self.moe.d_ff:
+            return self.moe
+        from dataclasses import replace
+
+        return replace(self.moe, d_ff=self.d_ff)
 
 
 # param name -> logical axes (leading "layers" axis on stacked per-layer params)
@@ -71,9 +85,22 @@ _TOP_AXES: Dict[str, tuple] = {
 }
 
 
+def _layer_axes(cfg: TransformerConfig) -> Dict[str, tuple]:
+    axes = dict(_LAYER_AXES)
+    if cfg.moe is not None:
+        for name in ("wi_gate", "wi_up", "wo_mlp"):
+            del axes[name]
+        # router replicated (tiny, precision-sensitive); experts over ep
+        axes["router"] = ("layers", None, None)
+        for name, ax in MOE_AXES.items():
+            if name != "router":
+                axes[name] = ("layers",) + ax
+    return axes
+
+
 def param_specs(cfg: TransformerConfig, mesh=None):
     """Pytree of PartitionSpec matching init_params' structure."""
-    layers = {k: logical_to_spec(ax, mesh) for k, ax in _LAYER_AXES.items()}
+    layers = {k: logical_to_spec(ax, mesh) for k, ax in _layer_axes(cfg).items()}
     top = {k: logical_to_spec(ax, mesh) for k, ax in _TOP_AXES.items()}
     return {**top, "layers": layers}
 
@@ -92,19 +119,31 @@ def init_params(rng, cfg: TransformerConfig):
             * (1.0 / fan_in) ** 0.5
         ).astype(cfg.dtype)
 
+    layers: Dict[str, Any] = {
+        "attn_norm": norm_init((L, d)),
+        "wqkv": dense_init(keys[2], (L, d, 3 * h, hd), d),
+        "wo": dense_init(keys[3], (L, h, hd, d), d),
+        "mlp_norm": norm_init((L, d)),
+    }
+    moe_cfg = cfg.moe_resolved
+    if moe_cfg is not None:
+        moe_keys = jax.random.split(keys[4], L)
+        layers.update(
+            jax.vmap(lambda k: init_moe_params(k, d, moe_cfg, cfg.dtype))(moe_keys)
+        )
+    else:
+        layers.update(
+            {
+                "wi_gate": dense_init(keys[4], (L, d, f), d),
+                "wi_up": dense_init(keys[5], (L, d, f), d),
+                "wo_mlp": dense_init(keys[6], (L, f, d), f),
+            }
+        )
     return {
         "embed": dense_init(keys[0], (cfg.vocab, d), d),
         "final_norm": norm_init((d,)),
         "unembed": dense_init(keys[1], (d, cfg.vocab), d),
-        "layers": {
-            "attn_norm": norm_init((L, d)),
-            "wqkv": dense_init(keys[2], (L, d, 3 * h, hd), d),
-            "wo": dense_init(keys[3], (L, h, hd, d), d),
-            "mlp_norm": norm_init((L, d)),
-            "wi_gate": dense_init(keys[4], (L, d, f), d),
-            "wi_up": dense_init(keys[5], (L, d, f), d),
-            "wo_mlp": dense_init(keys[6], (L, f, d), f),
-        },
+        "layers": layers,
     }
 
 
@@ -152,8 +191,13 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
     ).astype(cfg.dtype)
     x = constrain(x, ("batch", "seq", None))  # residual replicated over tp
 
-    # mlp (SwiGLU)
+    # mlp: routed experts (moe) or dense SwiGLU
     y = rms_norm(x, layer_params["mlp_norm"])
+    if cfg.moe is not None:
+        moe_params = {k: layer_params[k] for k in MOE_AXES}
+        mlp_out, aux = moe_ffn(y, moe_params, cfg.moe_resolved, mesh)
+        x = x + mlp_out
+        return x, aux
     gate = jnp.einsum(
         "bsd,df->bsf", y, layer_params["wi_gate"], preferred_element_type=jnp.float32
     )
@@ -165,12 +209,16 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
     x = x + jnp.einsum(
         "bsf,fd->bsd", act, layer_params["wo_mlp"], preferred_element_type=jnp.float32
     ).astype(cfg.dtype)
-    return x
+    return x, jnp.float32(0.0)
 
 
-def forward(params, tokens, cfg: TransformerConfig, mesh=None, positions=None):
+def forward(
+    params, tokens, cfg: TransformerConfig, mesh=None, positions=None, with_aux=False
+):
     """Logits for next-token prediction. tokens: (batch, seq) int32; with
-    sp-sharding, `positions` carries each shard's global positions."""
+    sp-sharding, `positions` carries each shard's global positions.
+    with_aux=True additionally returns the summed router auxiliary loss
+    (zero for dense configs)."""
     if positions is None:
         positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, tokens.shape)
@@ -197,27 +245,124 @@ def forward(params, tokens, cfg: TransformerConfig, mesh=None, positions=None):
     if cfg.remat:
         body = jax.checkpoint(body)
 
-    def scan_fn(carry, layer_params):
-        return body(carry, layer_params), None
-
-    x, _ = lax.scan(scan_fn, x, params["layers"])
+    x, auxes = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"])
     logits = jnp.einsum(
         "bsd,dv->bsv", x, params["unembed"], preferred_element_type=jnp.float32
     )
+    if with_aux:
+        return logits, jnp.sum(auxes)
     return logits
 
 
 def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
-    """Causal LM cross-entropy. batch: {"tokens": (b, s), "positions"?}."""
+    """Causal LM cross-entropy (+ router load-balance aux for MoE configs).
+    batch: {"tokens": (b, s), "positions"?}."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, mesh=mesh, positions=batch.get("positions"))
+    logits, aux = forward(
+        params, tokens, cfg, mesh=mesh, positions=batch.get("positions"), with_aux=True
+    )
     targets = batch.get("targets")
     if targets is None:
         logits, targets = logits[:, :-1], tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    loss = -jnp.mean(ll)
+    if cfg.moe is not None:
+        loss = loss + cfg.moe.router_aux_weight * aux / cfg.n_layers
+    return loss
+
+
+def pp_forward(params, tokens, cfg: TransformerConfig, mesh, n_micro: int = 4):
+    """Pipeline-parallel forward. `params["layers"]` must be STAGE-STACKED:
+    (S, L/S, ...) leaves, S == mesh["pp"], sharded over pp (see
+    `to_pp_params`) — the storage layout, so optimizer state shards the same
+    way. Microbatches stream through the stages (parallel/pipeline.py);
+    embedding and unembed run replicated over pp outside the pipeline.
+
+    Dense configs only for now — MoE aux losses don't thread through the
+    stage carry."""
+    if cfg.moe is not None:
+        raise NotImplementedError("pp_forward does not support MoE configs yet")
+    from ..parallel.pipeline import pipeline_apply
+
+    # (1, seq): broadcasts against any microbatch size inside the stages
+    positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+
+    table = params["embed"].astype(cfg.dtype)
+    x = table[tokens]
+
+    def stage_fn(stage_layers, h):
+        def scan_fn(carry, layer_params):
+            new_h, _ = _layer(carry, layer_params, positions, cfg, mesh=None)
+            return new_h, None
+
+        h, _ = lax.scan(scan_fn, h, stage_layers)
+        return h
+
+    x = pipeline_apply(stage_fn, params["layers"], x, mesh, n_micro=n_micro)
+    x = rms_norm(x, params["final_norm"])
+    return jnp.einsum(
+        "bsd,dv->bsv", x, params["unembed"], preferred_element_type=jnp.float32
+    )
+
+
+def pp_loss_fn(params, batch, cfg: TransformerConfig, mesh, n_micro: int = 4):
+    tokens = batch["tokens"]
+    logits = pp_forward(params, tokens, cfg, mesh, n_micro=n_micro)
+    logits, targets = logits[:, :-1], tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def make_pp_train_step(cfg: TransformerConfig, mesh, n_micro: int = 4, optimizer=None):
+    """Pipeline-parallel train step (GPipe schedule; grads flow back through
+    the ppermute hops)."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(
+        3e-4, b1=0.9, b2=0.95, weight_decay=0.1, mu_dtype=jnp.float32
+    )
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(pp_loss_fn)(params, batch, cfg, mesh, n_micro)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step, optimizer
+
+
+def to_pp_params(params, n_stages: int):
+    """(L, ...)-stacked params -> the pipeline storage layout ((S, L/S, ...)
+    layers; everything else unchanged)."""
+    from ..parallel.pipeline import stack_stages
+
+    return {
+        **{k: v for k, v in params.items() if k != "layers"},
+        "layers": stack_stages(params["layers"], n_stages),
+    }
+
+
+def pp_param_specs(cfg: TransformerConfig, mesh, n_stages: int):
+    """param_specs variant for pipeline training: per-layer params carry a
+    leading stage dim sharded over pp ((S, L/S, ...) layout, see
+    parallel/pipeline.stack_stages)."""
+    base = param_specs(cfg, mesh)
+    from jax.sharding import PartitionSpec
+
+    def add_stage(spec):
+        # stage dim over pp ONLY: pipeline_apply's shard_map runs each stage
+        # with locally-replicated weights, so storing them tp/fsdp-sharded
+        # would force a full all-gather every step (specs must match flow)
+        del spec
+        return PartitionSpec("pp")
+
+    return {
+        **{k: v for k, v in base.items() if k != "layers"},
+        "layers": {k: add_stage(v) for k, v in base["layers"].items()},
+    }
 
 
 def make_train_step(cfg: TransformerConfig, optimizer=None, mesh=None):

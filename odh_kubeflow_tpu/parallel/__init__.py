@@ -10,6 +10,7 @@ webhook injects (tpu/env.py) turns into a live ICI mesh with one call:
     mesh = MeshPlan.auto(len(jax.devices())).build()
 """
 from .distributed import initialize_from_env, slice_mesh_axes
+from .pipeline import pipeline_apply, stack_stages
 from .mesh import (
     AXES,
     MeshPlan,
@@ -20,6 +21,8 @@ from .mesh import (
 
 __all__ = [
     "AXES",
+    "pipeline_apply",
+    "stack_stages",
     "MeshPlan",
     "batch_spec",
     "initialize_from_env",

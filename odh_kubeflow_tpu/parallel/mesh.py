@@ -10,7 +10,11 @@ names, let XLA insert the collectives. Axes:
 - ``tp``    tensor parallelism over heads / mlp-hidden — innermost, most
             bandwidth-hungry, so closest ICI neighbors,
 - ``sp``    sequence/context parallelism for long contexts (ring attention,
-            ops/ring_attention.py).
+            ops/ring_attention.py),
+- ``ep``    expert parallelism: MoE expert weights shard over it and token
+            dispatch/combine einsums induce the all-to-alls (models/moe.py),
+- ``pp``    pipeline parallelism: layer stages shard over it; activations
+            hop stages via collective_permute (parallel/pipeline.py).
 
 Parameters and activations carry *logical* axis names ("vocab", "embed",
 "heads", "mlp", "batch", "seq"); `logical_to_spec` maps them onto mesh axes
@@ -23,7 +27,7 @@ from typing import Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-AXES: Tuple[str, ...] = ("dp", "fsdp", "tp", "sp")
+AXES: Tuple[str, ...] = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
 # logical axis -> mesh axis (or tuple of mesh axes). None = replicated.
 RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
@@ -37,6 +41,8 @@ RULES: Dict[str, Union[str, Tuple[str, ...], None]] = {
     "head_dim": None,
     "layers": None,
     "norm": None,
+    "expert": "ep",
+    "stage": "pp",
 }
 
 
@@ -46,25 +52,36 @@ class MeshPlan:
 
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.pp * self.ep * self.tp * self.sp
 
     def sizes(self) -> Dict[str, int]:
-        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        return {
+            "dp": self.dp,
+            "fsdp": self.fsdp,
+            "pp": self.pp,
+            "ep": self.ep,
+            "tp": self.tp,
+            "sp": self.sp,
+        }
 
     @staticmethod
     def auto(
         n_devices: int,
         want_sp: int = 1,
         want_tp: int = 1,
+        want_ep: int = 1,
+        want_pp: int = 1,
         prefer_fsdp: bool = True,
     ) -> "MeshPlan":
-        """Factor n_devices into mesh axes. sp/tp are capped at what divides;
-        the remainder goes to fsdp (or dp if prefer_fsdp=False).
+        """Factor n_devices into mesh axes. sp/tp/ep/pp are capped at what
+        divides; the remainder goes to fsdp (or dp if prefer_fsdp=False).
 
         Deterministic and total: any n >= 1 yields a valid plan.
         """
@@ -81,13 +98,19 @@ class MeshPlan:
         rest //= sp
         tp = largest_divisor_leq(rest, want_tp)
         rest //= tp
+        ep = largest_divisor_leq(rest, want_ep)
+        rest //= ep
+        pp = largest_divisor_leq(rest, want_pp)
+        rest //= pp
         if prefer_fsdp:
-            return MeshPlan(dp=1, fsdp=rest, tp=tp, sp=sp)
-        return MeshPlan(dp=rest, fsdp=1, tp=tp, sp=sp)
+            return MeshPlan(dp=1, fsdp=rest, pp=pp, ep=ep, tp=tp, sp=sp)
+        return MeshPlan(dp=rest, fsdp=1, pp=pp, ep=ep, tp=tp, sp=sp)
 
     def build(self, devices: Optional[Sequence] = None):
-        """Build the jax.sharding.Mesh. Axis order is (dp, fsdp, tp, sp) with
-        tp/sp innermost so their collectives ride nearest-neighbor ICI."""
+        """Build the jax.sharding.Mesh. Axis order is (dp, fsdp, pp, ep, tp,
+        sp): tp/sp innermost so their (heaviest) collectives ride nearest-
+        neighbor ICI; pp outermost of the model axes — stage hops are the
+        rarest, largest-granularity transfers."""
         import jax
 
         devices = list(devices if devices is not None else jax.devices())
@@ -96,7 +119,9 @@ class MeshPlan:
                 f"MeshPlan{self.sizes()} needs {self.n_devices} devices, "
                 f"got {len(devices)}"
             )
-        grid = np.array(devices).reshape(self.dp, self.fsdp, self.tp, self.sp)
+        grid = np.array(devices).reshape(
+            self.dp, self.fsdp, self.pp, self.ep, self.tp, self.sp
+        )
         return jax.sharding.Mesh(grid, AXES)
 
 

@@ -2,6 +2,7 @@
 import os
 
 import jax
+import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec
 
@@ -26,8 +27,8 @@ def test_auto_plan_factors_exactly():
 
 def test_mesh_build_and_axis_order():
     mesh = MeshPlan(fsdp=2, tp=2, sp=2).build()
-    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp")
-    assert mesh.devices.shape == (1, 2, 2, 2)
+    assert mesh.axis_names == ("dp", "fsdp", "pp", "ep", "tp", "sp")
+    assert mesh.devices.shape == (1, 2, 1, 1, 2, 2)
     with pytest.raises(ValueError):
         MeshPlan(fsdp=4).build(jax.devices()[:3])
 
@@ -76,3 +77,120 @@ def test_slice_mesh_axes_defaults_tp_to_host_chips():
     assert plan.tp == 4  # tp collectives stay on one host's chips
     long_ctx = slice_mesh_axes(shape, want_sp=4)
     assert long_ctx.sp == 4 and long_ctx.n_devices == 16
+
+
+# ---- pipeline parallelism (pp axis) ----
+
+
+def test_pipeline_apply_matches_sequential():
+    """pp=4 pipeline over microbatches == running the stages sequentially."""
+    import numpy as np
+
+    from odh_kubeflow_tpu.parallel import MeshPlan, pipeline_apply, stack_stages
+
+    plan = MeshPlan.auto(8, want_pp=4, want_tp=2)
+    assert plan.pp == 4
+    mesh = plan.build(jax.devices()[:8])
+
+    L, d = 8, 16
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (L, d, d)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+
+    def stage_fn(stage_w, h):
+        def body(carry, wi):
+            return jnp.tanh(carry @ wi), None
+
+        h, _ = jax.lax.scan(body, h, stage_w)
+        return h
+
+    stages = stack_stages(w, 4)
+    assert stages.shape == (4, 2, d, d)
+    y_pipe = jax.jit(
+        lambda s, x: pipeline_apply(stage_fn, s, x, mesh, n_micro=4)
+    )(stages, x)
+
+    y_seq = x
+    for i in range(L):
+        y_seq = jnp.tanh(y_seq @ w[i])
+    assert np.allclose(np.asarray(y_pipe), np.asarray(y_seq), atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    """Backprop through ppermute hops equals the sequential gradient."""
+    import numpy as np
+
+    from odh_kubeflow_tpu.parallel import MeshPlan, pipeline_apply, stack_stages
+
+    mesh = MeshPlan.auto(8, want_pp=2, want_tp=4).build(jax.devices()[:8])
+    L, d = 4, 8
+    w = jax.random.normal(jax.random.PRNGKey(0), (L, d, d)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, d))
+
+    def stage_fn(stage_w, h):
+        def body(carry, wi):
+            return jnp.tanh(carry @ wi), None
+
+        h, _ = jax.lax.scan(body, h, stage_w)
+        return h
+
+    def loss_pipe(w):
+        y = pipeline_apply(stage_fn, stack_stages(w, 2), x, mesh, n_micro=2)
+        return jnp.sum(y**2)
+
+    def loss_seq(w):
+        y = x
+        for i in range(L):
+            y = jnp.tanh(y @ w[i])
+        return jnp.sum(y**2)
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(w)
+    g_seq = jax.grad(loss_seq)(w)
+    assert np.allclose(np.asarray(g_pipe), np.asarray(g_seq), atol=1e-5)
+
+
+def test_pp_transformer_train_step():
+    """Flagship model trains under pp=2 with sharded stage params; loss
+    matches the non-pipelined model on identical inputs."""
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from odh_kubeflow_tpu.models import (
+        TransformerConfig,
+        init_params,
+        loss_fn,
+        make_pp_train_step,
+        pp_param_specs,
+    )
+    from odh_kubeflow_tpu.parallel import MeshPlan, shard_batch
+    from odh_kubeflow_tpu.parallel.pipeline import stack_stages
+
+    plan = MeshPlan.auto(8, want_pp=2, want_tp=2)
+    assert plan.pp == 2
+    mesh = plan.build(jax.devices()[:8])
+    cfg = TransformerConfig(
+        vocab=64,
+        d_model=32,
+        n_layers=4,
+        n_heads=2,
+        d_ff=64,
+        dtype=jnp.float32,
+        use_flash=False,
+        remat=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    ref_loss = loss_fn(params, {"tokens": jnp.ones((4, 16), jnp.int32)}, cfg)
+
+    from odh_kubeflow_tpu.models.transformer import to_pp_params
+
+    pp_params = to_pp_params(params, 2)
+    specs = pp_param_specs(cfg, mesh, 2)
+    pp_params = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), pp_params, specs
+    )
+    step, opt = make_pp_train_step(cfg, mesh, n_micro=2)
+    opt_state = opt.init(pp_params)
+    batch = shard_batch(mesh, {"tokens": jnp.ones((4, 16), jnp.int32)})
+    new_params, opt_state, loss = jax.jit(step)(pp_params, opt_state, batch)
+    jax.block_until_ready(loss)
+    assert np.allclose(float(loss), float(ref_loss), atol=1e-4)
