@@ -177,6 +177,51 @@ def bench_train_step():
     }
 
 
+def bench_decode():
+    """KV-cache autoregressive decoding: tokens/s for a whole generate call
+    (prefill + scanned decode loop, ONE compiled program)."""
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import TransformerConfig, generate, init_params
+
+    cfg = TransformerConfig(
+        vocab=32768,
+        d_model=1024,
+        n_layers=8,
+        n_heads=8,
+        d_ff=4096,
+        max_seq=2048,
+        dtype=jnp.bfloat16,
+        use_flash=True,
+        remat=False,
+    )
+    batch, prompt_len, max_new = 8, 128, 128
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab)
+    out = generate(params, prompt, cfg, max_new=max_new)  # compile + warm
+    jax.block_until_ready(out)
+    jax.block_until_ready(generate(params, prompt, cfg, max_new=1, max_seq=prompt_len + max_new))
+    t0 = time.perf_counter()
+    out = generate(params, prompt, cfg, max_new=max_new)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    # separate the prefill so per-decode-token cost is not inflated by it
+    t0 = time.perf_counter()
+    jax.block_until_ready(generate(params, prompt, cfg, max_new=1, max_seq=prompt_len + max_new))
+    prefill_s = time.perf_counter() - t0
+    decode_s = max(elapsed - prefill_s, 1e-9)
+    return {
+        "generate_tokens_per_s": round(batch * max_new / elapsed),
+        "decode_only_tokens_per_s": round(batch * (max_new - 1) / decode_s),
+        "decode_per_token_ms": round(decode_s / (max_new - 1) * 1e3, 2),
+        "prefill_ms": round(prefill_s * 1e3, 1),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "max_new": max_new,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Control-plane half (the round-1 benchmark, reported on its own terms)
 # ---------------------------------------------------------------------------
@@ -274,6 +319,10 @@ def main() -> None:
             detail["train_step"] = train = bench_train_step()
         except Exception as e:  # pragma: no cover
             detail["train_step"] = {"error": repr(e)[:300]}
+        try:
+            detail["decode"] = bench_decode()
+        except Exception as e:  # pragma: no cover
+            detail["decode"] = {"error": repr(e)[:300]}
     try:
         detail["control_plane"] = bench_control_plane()
     except SystemExit as e:

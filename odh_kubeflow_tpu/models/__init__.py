@@ -4,6 +4,8 @@ The reference ships no model code (its payload is the user's image); the
 TPU-native build ships a reference workload so a provisioned slice can be
 exercised, benchmarked, and utilization-probed out of the box.
 """
+from .checkpoint import latest_step, restore_train_state, save_train_state
+from .decode import KVCache, decode_step, generate, init_cache, prefill
 from .moe import MoEConfig, moe_ffn, route_topk
 from .transformer import (
     TransformerConfig,
@@ -19,7 +21,15 @@ from .transformer import (
 )
 
 __all__ = [
+    "KVCache",
     "MoEConfig",
+    "decode_step",
+    "generate",
+    "init_cache",
+    "prefill",
+    "latest_step",
+    "restore_train_state",
+    "save_train_state",
     "TransformerConfig",
     "forward",
     "init_params",

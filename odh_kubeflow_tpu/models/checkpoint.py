@@ -1,0 +1,78 @@
+"""Workload checkpoint/resume (orbax-backed, sharding-aware).
+
+The control plane's checkpoint story is declarative state in the API server
+(SURVEY §5: annotations as a durable state machine); the WORKLOAD's is this
+module: train state (params + optimizer state + step) saved per-shard by
+orbax and restored onto whatever mesh the resumed notebook gets — the
+pieces a culled/restarted/resized slice needs to continue a run. Paired with
+the operator's flow: cull scales the slice away, wake-up reschedules it, the
+workload calls `restore_train_state` and resumes exactly.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _manager(directory: str, max_to_keep: int = 3, create: bool = False):
+    import orbax.checkpoint as ocp
+
+    # create only on the save path: a read (latest_step/restore) of a typo'd
+    # path must not mkdir it and masquerade as an empty checkpoint dir
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=create),
+    )
+
+
+def save_train_state(directory: str, step: int, state: Any, max_to_keep: int = 3) -> None:
+    """Save {params, opt_state, ...} at `step`. Arrays are written per shard
+    (each host writes only what it owns — multi-host safe)."""
+    import orbax.checkpoint as ocp
+
+    mngr = _manager(directory, max_to_keep, create=True)
+    mngr.save(step, args=ocp.args.StandardSave(state))
+    mngr.wait_until_finished()
+    mngr.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+    mngr = _manager(directory)
+    step = mngr.latest_step()
+    mngr.close()
+    return step
+
+
+def restore_train_state(
+    directory: str, like: Any, step: Optional[int] = None, mesh=None
+) -> Any:
+    """Restore onto the shardings of `like` (a pytree of arrays OR
+    jax.ShapeDtypeStruct with .sharding) — the resumed slice's mesh need not
+    be the one that saved, as long as shapes match.
+
+    With `mesh`, leaves of `like` that carry no mesh sharding (e.g. the
+    optimizer's step counter created by an un-jitted opt.init) restore
+    replicated over it instead of pinned to one device — mixing
+    single-device and mesh-wide arrays would poison the next jitted step."""
+    import orbax.checkpoint as ocp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mngr = _manager(directory)
+    step = mngr.latest_step() if step is None else step
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint under {directory!r}")
+
+    def as_abstract(x):
+        sharding = getattr(x, "sharding", None)
+        if mesh is not None and not isinstance(sharding, NamedSharding):
+            sharding = NamedSharding(mesh, PartitionSpec())
+        if sharding is not None:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+        return x
+
+    abstract = jax.tree_util.tree_map(as_abstract, like)
+    state = mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mngr.close()
+    return state

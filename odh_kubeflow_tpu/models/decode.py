@@ -1,0 +1,177 @@
+"""Autoregressive decoding with a KV cache.
+
+Inference path for the flagship transformer: `prefill` runs the prompt once
+(flash attention) while recording per-layer K/V; `decode_step` then attends a
+single query token against the cache — O(seq) per token instead of O(seq²)
+re-forwarding. Everything is static-shaped for XLA: the cache is allocated at
+`max_seq` up front, positions advance by `lax.dynamic_update_slice`, and the
+generation loop is a `lax.scan`, so the whole generate call compiles to one
+program (no per-token dispatch — essential under any dispatch-latency floor,
+cf. bench.py's tunnel note).
+
+Decode attention is deliberately the einsum path, not the pallas kernel: a
+1-token query is HBM-bandwidth-bound (reading the cache), with no O(s²)
+score matrix to avoid.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import rms_norm
+from .transformer import (
+    TransformerConfig,
+    layer_post_attention,
+    layer_qkv,
+)
+
+NEG_INF = -1e30
+
+
+@dataclass
+class KVCache:
+    """Per-layer stacked cache: k/v are (L, batch, max_seq, heads, head_dim);
+    `length` is the number of valid positions."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray  # scalar int32
+
+
+jax.tree_util.register_dataclass(KVCache, ["k", "v", "length"], [])
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_seq: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        length=jnp.zeros((), jnp.int32),
+    )
+
+
+def _finish_layer(x, attn, layer_params, cfg: TransformerConfig):
+    out, _aux = layer_post_attention(x, attn, layer_params, cfg, mesh=None)
+    return out
+
+
+def prefill(
+    params, tokens: jnp.ndarray, cfg: TransformerConfig, max_seq: int
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the prompt, returning last-position logits and the primed cache.
+    tokens: (batch, prompt_len); prompt_len <= max_seq."""
+    from .transformer import _attention
+
+    b, s = tokens.shape
+    cache = init_cache(cfg, b, max_seq)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def scan_fn(carry, layer_params):
+        h = carry
+        q, k, v = layer_qkv(h, layer_params, positions, cfg)
+        attn = _attention(q, k, v, cfg, mesh=None)
+        h = _finish_layer(h, attn, layer_params, cfg)
+        return h, (k, v)
+
+    x, (ks, vs) = lax.scan(scan_fn, x, params["layers"])
+    # place the prompt K/V at cache[:, :, :s]
+    cache = KVCache(
+        k=lax.dynamic_update_slice(cache.k, ks, (0, 0, 0, 0, 0)),
+        v=lax.dynamic_update_slice(cache.v, vs, (0, 0, 0, 0, 0)),
+        length=jnp.asarray(s, jnp.int32),
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, -1], params["unembed"], preferred_element_type=jnp.float32
+    )
+    return logits, cache
+
+
+def decode_step(
+    params, cache: KVCache, token: jnp.ndarray, cfg: TransformerConfig
+) -> Tuple[jnp.ndarray, KVCache]:
+    """One token for the whole batch: token (batch,) int32 at position
+    cache.length. Returns next-token logits (batch, vocab) and the updated
+    cache."""
+    b = token.shape[0]
+    pos = cache.length  # scalar
+    positions = jnp.broadcast_to(pos[None, None], (b, 1)).astype(jnp.int32)
+    x = params["embed"].astype(cfg.dtype)[token][:, None, :]  # (b, 1, d)
+    max_seq = cache.k.shape[2]
+    # mask over cache positions: attend to <= pos (static shape, masked)
+    valid = jnp.arange(max_seq) <= pos  # (max_seq,)
+
+    def scan_fn(carry, inputs):
+        h = carry
+        layer_params, k_cache, v_cache = inputs
+        q, k, v = layer_qkv(h, layer_params, positions, cfg)  # (b,1,h,hd)
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+        ) * (cfg.head_dim**-0.5)
+        scores = jnp.where(valid[None, None, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bhqk,bkhd->bqhd", probs, v_cache, preferred_element_type=jnp.float32
+        ).astype(cfg.dtype)
+        h = _finish_layer(h, attn, layer_params, cfg)
+        return h, (k_cache, v_cache)
+
+    x, (ks, vs) = lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
+    cache = KVCache(k=ks, v=vs, length=pos + 1)
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, 0], params["unembed"], preferred_element_type=jnp.float32
+    )
+    return logits, cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "max_seq", "temperature"))
+def generate(
+    params,
+    prompt: jnp.ndarray,
+    cfg: TransformerConfig,
+    max_new: int,
+    max_seq: int = 0,
+    rng: Optional[jnp.ndarray] = None,
+    temperature: float = 0.0,
+) -> jnp.ndarray:
+    """Greedy (temperature 0) or sampled generation: (batch, prompt_len) ->
+    (batch, max_new) new tokens. One compiled program: prefill + a scanned
+    decode loop."""
+    b, s = prompt.shape
+    max_seq = max_seq or (s + max_new)
+    if s + max_new > max_seq:
+        # dynamic_update_slice CLAMPS out-of-range starts: decoding past the
+        # cache would silently overwrite the last slot, not raise
+        raise ValueError(
+            f"prompt ({s}) + max_new ({max_new}) exceeds cache max_seq ({max_seq})"
+        )
+    logits, cache = prefill(params, prompt, cfg, max_seq)
+
+    def pick(logits, key):
+        if temperature > 0.0:
+            return jax.random.categorical(key, logits / temperature, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # one split up front: reusing rng for the first pick AND as the parent of
+    # the scan keys would correlate the first sample with the rest
+    all_keys = jax.random.split(rng, max_new + 1)
+    first = pick(logits, all_keys[0])
+
+    def scan_fn(carry, key):
+        token, cache = carry
+        logits, cache = decode_step(params, cache, token, cfg)
+        nxt = pick(logits, key)
+        return (nxt, cache), token
+
+    (_, _), tokens = lax.scan(scan_fn, (first, cache), all_keys[1:])
+    return jnp.moveaxis(tokens, 0, 1)  # (batch, max_new)

@@ -165,10 +165,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
     return mha_reference(q, k, v, causal=True)
 
 
-def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
-    """One pre-norm block. x: (batch, seq, d_model)."""
-    h, hd = cfg.n_heads, cfg.head_dim
-
+def _constrainer(cfg: TransformerConfig, mesh):
     def constrain(y, axes):
         if mesh is None:
             return y
@@ -176,7 +173,13 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
             y, jax.sharding.NamedSharding(mesh, logical_to_spec(axes, mesh))
         )
 
-    # attention
+    return constrain
+
+
+def layer_qkv(x, layer_params, positions, cfg: TransformerConfig):
+    """Attention-half prelude shared with the decode path (models/decode.py):
+    pre-norm, fused QKV projection, rope. Returns (q, k, v), each
+    (batch, seq, heads, head_dim)."""
     y = rms_norm(x, layer_params["attn_norm"])
     qkv = jnp.einsum(
         "bsd,dnh->bsnh", y, layer_params["wqkv"], preferred_element_type=jnp.float32
@@ -184,8 +187,13 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
     q, k, v = jnp.split(qkv, 3, axis=2)  # (b, s, h, hd) each
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v, cfg, mesh)
-    attn = constrain(attn, ("batch", "seq", "heads", "head_dim"))
+    return q, k, v
+
+
+def layer_post_attention(x, attn, layer_params, cfg: TransformerConfig, mesh=None):
+    """Attention output projection + MLP half (dense SwiGLU or MoE), shared
+    with the decode path. Returns (x, aux)."""
+    constrain = _constrainer(cfg, mesh)
     x = x + jnp.einsum(
         "bsnh,nhd->bsd", attn, layer_params["wo"], preferred_element_type=jnp.float32
     ).astype(cfg.dtype)
@@ -196,8 +204,7 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
     if cfg.moe is not None:
         moe_params = {k: layer_params[k] for k in MOE_AXES}
         mlp_out, aux = moe_ffn(y, moe_params, cfg.moe_resolved, mesh)
-        x = x + mlp_out
-        return x, aux
+        return x + mlp_out, aux
     gate = jnp.einsum(
         "bsd,df->bsf", y, layer_params["wi_gate"], preferred_element_type=jnp.float32
     )
@@ -210,6 +217,15 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
         "bsf,fd->bsd", act, layer_params["wo_mlp"], preferred_element_type=jnp.float32
     ).astype(cfg.dtype)
     return x, jnp.float32(0.0)
+
+
+def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
+    """One pre-norm block. x: (batch, seq, d_model)."""
+    constrain = _constrainer(cfg, mesh)
+    q, k, v = layer_qkv(x, layer_params, positions, cfg)
+    attn = _attention(q, k, v, cfg, mesh)
+    attn = constrain(attn, ("batch", "seq", "heads", "head_dim"))
+    return layer_post_attention(x, attn, layer_params, cfg, mesh)
 
 
 def forward(

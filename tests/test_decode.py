@@ -1,0 +1,105 @@
+"""KV-cache autoregressive decoding (models/decode.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from odh_kubeflow_tpu.models import (
+    MoEConfig,
+    TransformerConfig,
+    decode_step,
+    forward,
+    generate,
+    init_params,
+    prefill,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab=97,
+        d_model=32,
+        n_layers=2,
+        n_heads=2,
+        d_ff=64,
+        dtype=jnp.float32,
+        use_flash=False,
+        remat=False,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_decode_matches_full_forward():
+    """Each decode_step's logits equal the full forward's last-position
+    logits on the same prefix — the KV cache is exact, not approximate."""
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab)
+
+    logits, cache = prefill(params, prompt, cfg, max_seq=16)
+    full = forward(params, prompt, cfg)
+    assert np.allclose(np.asarray(logits), np.asarray(full[:, -1]), atol=1e-3)
+
+    seq = prompt
+    for step in range(4):
+        nxt = jnp.argmax(logits, axis=-1)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        logits, cache = decode_step(params, cache, nxt, cfg)
+        full = forward(params, seq, cfg)
+        assert np.allclose(
+            np.asarray(logits), np.asarray(full[:, -1]), atol=1e-3
+        ), f"divergence at decode step {step}"
+
+
+def test_generate_greedy_matches_forward_argmax():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 4), 0, cfg.vocab)
+    out = generate(params, prompt, cfg, max_new=5)
+    assert out.shape == (1, 5)
+
+    # reference: greedy re-forwarding the growing sequence
+    seq = prompt
+    expected = []
+    for _ in range(5):
+        logits = forward(params, seq, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1)
+        expected.append(int(nxt[0]))
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    assert [int(t) for t in out[0]] == expected
+
+
+def test_generate_sampled_is_deterministic_per_key():
+    cfg = _cfg()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.ones((2, 3), jnp.int32)
+    a = generate(params, prompt, cfg, max_new=4, rng=jax.random.PRNGKey(7),
+                 temperature=1.0)
+    b = generate(params, prompt, cfg, max_new=4, rng=jax.random.PRNGKey(7),
+                 temperature=1.0)
+    assert jnp.array_equal(a, b)
+    assert a.shape == (2, 4)
+    # different keys must produce at least one different sequence among a
+    # handful of tries (an rng-ignoring bug would make them ALL identical)
+    diverged = any(
+        not jnp.array_equal(
+            a,
+            generate(params, prompt, cfg, max_new=4,
+                     rng=jax.random.PRNGKey(100 + i), temperature=1.0),
+        )
+        for i in range(5)
+    )
+    assert diverged, "sampling ignored the rng"
+
+
+def test_decode_with_moe_ffn():
+    cfg = _cfg(moe=MoEConfig(n_experts=2, experts_per_token=2, capacity_factor=4.0))
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.ones((1, 4), jnp.int32)
+    logits, cache = prefill(params, prompt, cfg, max_seq=8)
+    full = forward(params, prompt, cfg)
+    assert np.allclose(np.asarray(logits), np.asarray(full[:, -1]), atol=1e-3)
+    nxt = jnp.argmax(logits, axis=-1)
+    logits2, cache = decode_step(params, cache, nxt, cfg)
+    assert jnp.all(jnp.isfinite(logits2))
