@@ -299,6 +299,47 @@ class RemoteStore:
                 ctx.load_cert_chain(client_cert[0], client_cert[1])
             self._ssl_ctx = ctx
 
+    # -- in-cluster bootstrap (rest.InClusterConfig analog) --
+
+    SERVICEACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    @classmethod
+    def in_cluster(
+        cls, scheme: Scheme = default_scheme, sa_dir: Optional[str] = None
+    ) -> "RemoteStore":
+        """Bootstrap from the pod environment: apiserver address from
+        KUBERNETES_SERVICE_HOST/PORT, bearer token + CA from the
+        ServiceAccount projection — how the deployed manager authenticates
+        (the reference's ctrl.GetConfigOrDie resolves the same way in-pod)."""
+        sa_dir = sa_dir or cls.SERVICEACCOUNT_DIR
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError(
+                "not in a cluster: KUBERNETES_SERVICE_HOST unset "
+                "(use from_kubeconfig outside a pod)"
+            )
+        token_path = os.path.join(sa_dir, "token")
+        ca_path = os.path.join(sa_dir, "ca.crt")
+        with open(token_path) as f:
+            token = f.read().strip()
+        if not os.path.exists(ca_path):
+            # fail loudly like the missing token does: falling back to the
+            # system trust store would surface as an opaque TLS error later
+            raise FileNotFoundError(f"in-cluster CA bundle missing: {ca_path}")
+        if ":" in host and not host.startswith("["):
+            host = f"[{host}]"  # IPv6 service address
+        store = cls(
+            base_url=f"https://{host}:{port}",
+            token=token,
+            ca_file=ca_path,
+            scheme=scheme,
+        )
+        # bound SA tokens rotate (~1h); re-read the projection per request
+        # like client-go, or every call 401s after the first expiry
+        store.token_file = token_path
+        return store
+
     # -- kubeconfig bootstrap (ctrl.GetConfigOrDie analog) --
 
     @classmethod
@@ -353,12 +394,21 @@ class RemoteStore:
 
     # -- HTTP plumbing --
 
+    token_file: Optional[str] = None  # set by in_cluster(): rotating SA token
+
     def _headers(self, content_type: Optional[str] = None) -> Dict[str, str]:
         headers = {"Accept": "application/json"}
         if content_type:
             headers["Content-Type"] = content_type
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
+        token = self.token
+        if self.token_file:
+            try:
+                with open(self.token_file) as f:
+                    token = f.read().strip()
+            except OSError:
+                pass  # keep the last known token; kubelet may be mid-refresh
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         return headers
 
     def _open(self, path: str, method: str = "GET", body: Optional[bytes] = None,

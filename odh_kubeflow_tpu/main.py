@@ -76,12 +76,14 @@ def serve_webhook(client, config: Config, cert_dir: str, port: int = 8443):
 
 
 def main() -> None:  # pragma: no cover - thin CLI shell
-    """Entrypoint. Two modes, chosen by KUBECONFIG (ctrl.GetConfigOrDie analog):
+    """Entrypoint, resolved like ctrl.GetConfigOrDie:
 
-    - KUBECONFIG set (the deployed shape): connect to the API server over the
-      wire, serve the mutating webhook over HTTPS from WEBHOOK_CERT_DIR, and
-      run all controllers against the real cluster.
-    - otherwise: boot the in-process SimCluster (the dev/demo shape).
+    - in a pod (KUBERNETES_SERVICE_HOST set): in-cluster config — SA token +
+      CA from the ServiceAccount mount; the deployed shape,
+    - KUBECONFIG set: connect via kubeconfig (remote dev shape),
+    - otherwise: boot the in-process SimCluster (demo shape).
+    In both real modes the mutating webhook serves over HTTPS from
+    WEBHOOK_CERT_DIR and all controllers run against the real cluster.
     """
     import os
 
@@ -89,12 +91,18 @@ def main() -> None:  # pragma: no cover - thin CLI shell
     config = Config.from_env()
     cluster = None
     webhook_server = None
-    # explicit opt-in only: a merely-existing ~/.kube/config must never flip a
-    # demo run into mutating whatever cluster current-context points at
-    if os.environ.get("KUBECONFIG"):
+    # explicit signals only: a merely-existing ~/.kube/config must never flip
+    # a demo run into mutating whatever cluster current-context points at
+    if os.environ.get("KUBERNETES_SERVICE_HOST") or os.environ.get("KUBECONFIG"):
         from .cluster.remote import RemoteStore
 
-        store = RemoteStore.from_kubeconfig()
+        # KUBECONFIG first (GetConfig precedence): an explicit override must
+        # win over the auto-injected pod env, or a manager run inside ANY pod
+        # would silently target the host cluster
+        if os.environ.get("KUBECONFIG"):
+            store = RemoteStore.from_kubeconfig()
+        else:
+            store = RemoteStore.in_cluster()
         cert_dir = os.environ.get("WEBHOOK_CERT_DIR", "/tmp/k8s-webhook-server/serving-certs")
         if os.path.exists(os.path.join(cert_dir, "tls.crt")):
             from .cluster.client import Client

@@ -40,9 +40,10 @@ def save_train_state(directory: str, step: int, state: Any, max_to_keep: int = 3
 
 def latest_step(directory: str) -> Optional[int]:
     mngr = _manager(directory)
-    step = mngr.latest_step()
-    mngr.close()
-    return step
+    try:
+        return mngr.latest_step()
+    finally:
+        mngr.close()
 
 
 def restore_train_state(
@@ -60,19 +61,20 @@ def restore_train_state(
     from jax.sharding import NamedSharding, PartitionSpec
 
     mngr = _manager(directory)
-    step = mngr.latest_step() if step is None else step
-    if step is None:
-        raise FileNotFoundError(f"no checkpoint under {directory!r}")
+    try:
+        step = mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory!r}")
 
-    def as_abstract(x):
-        sharding = getattr(x, "sharding", None)
-        if mesh is not None and not isinstance(sharding, NamedSharding):
-            sharding = NamedSharding(mesh, PartitionSpec())
-        if sharding is not None:
-            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
-        return x
+        def as_abstract(x):
+            sharding = getattr(x, "sharding", None)
+            if mesh is not None and not isinstance(sharding, NamedSharding):
+                sharding = NamedSharding(mesh, PartitionSpec())
+            if sharding is not None:
+                return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+            return x
 
-    abstract = jax.tree_util.tree_map(as_abstract, like)
-    state = mngr.restore(step, args=ocp.args.StandardRestore(abstract))
-    mngr.close()
-    return state
+        abstract = jax.tree_util.tree_map(as_abstract, like)
+        return mngr.restore(step, args=ocp.args.StandardRestore(abstract))
+    finally:
+        mngr.close()

@@ -173,5 +173,8 @@ def generate(
         nxt = pick(logits, key)
         return (nxt, cache), token
 
-    (_, _), tokens = lax.scan(scan_fn, (first, cache), all_keys[1:])
-    return jnp.moveaxis(tokens, 0, 1)  # (batch, max_new)
+    # max_new - 1 steps: the scan emits its INPUT token each iteration, so
+    # a max_new-length scan would run one whole discarded decode step
+    (last, _), tokens = lax.scan(scan_fn, (first, cache), all_keys[1:max_new])
+    tokens = jnp.concatenate([jnp.moveaxis(tokens, 0, 1), last[:, None]], axis=1)
+    return tokens  # (batch, max_new)

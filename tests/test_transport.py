@@ -347,3 +347,33 @@ def test_watch_label_selector_filtering(served):
         from odh_kubeflow_tpu.cluster.remote import _abort_stream
 
         _abort_stream(resp)
+
+
+def test_in_cluster_config(tmp_path, monkeypatch):
+    """rest.InClusterConfig analog: apiserver address from the pod env,
+    bearer token + CA from the ServiceAccount mount."""
+    from odh_kubeflow_tpu.utils.certs import generate_cert_dir
+
+    ca, crt, key = generate_cert_dir(str(tmp_path / "pki"))
+    store = Store()
+    server = ApiServer(store, bearer_token="sa-token", certfile=crt, keyfile=key).start()
+    try:
+        sa_dir = tmp_path / "serviceaccount"
+        sa_dir.mkdir()
+        (sa_dir / "token").write_text("sa-token\n")
+        import shutil
+
+        shutil.copy(ca, sa_dir / "ca.crt")
+        host, port = server.address
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "127.0.0.1")
+        monkeypatch.setenv("KUBERNETES_SERVICE_PORT", str(port))
+        remote = RemoteStore.in_cluster(sa_dir=str(sa_dir))
+        remote.timeout = 5
+        remote.create_raw(cm("from-pod"))
+        assert remote.get_raw("v1", "ConfigMap", "default", "from-pod")
+
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST")
+        with pytest.raises(RuntimeError, match="not in a cluster"):
+            RemoteStore.in_cluster(sa_dir=str(sa_dir))
+    finally:
+        server.stop()
