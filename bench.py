@@ -119,6 +119,23 @@ def bench_kernels():
     }
     out["speedup_vs_reference"] = round(best_speedup, 2)
     out["kernel_mfu"] = round(best_mfu, 3)
+
+    # calibration: a square matmul with the SAME total FLOPs as the 4k case
+    # establishes this stack's practical ceiling at that grain (the tunnel
+    # adds a per-launch floor; nominal-peak MFU is not reachable for any op
+    # of this size here). flash-vs-this ratio is the honest efficiency read.
+    m = 4096  # 2*m^3 == the 4k attention case's 1.37e11 FLOPs
+    a = jax.random.normal(key, (m, m), jnp.bfloat16)
+    t_mm = _bench_ingraph(
+        lambda x, w: (x @ w).astype(jnp.bfloat16), (a, a), 20, fetch
+    )
+    mm_tflops = 2 * m**3 / t_mm / 1e12
+    out["calibration"] = {
+        "equal_flops_matmul_tflops": round(mm_tflops, 1),
+        "flash_4k_vs_matmul_ceiling": round(
+            out["4k"]["flash_tflops"] / mm_tflops, 2
+        ),
+    }
     return out
 
 

@@ -404,9 +404,9 @@ class RemoteStore:
         if self.token_file:
             try:
                 with open(self.token_file) as f:
-                    token = f.read().strip()
+                    token = f.read().strip() or self.token
             except OSError:
-                pass  # keep the last known token; kubelet may be mid-refresh
+                token = self.token  # keep the last known token (mid-refresh)
         if token:
             headers["Authorization"] = f"Bearer {token}"
         return headers

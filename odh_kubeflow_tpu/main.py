@@ -116,6 +116,20 @@ def main() -> None:  # pragma: no cover - thin CLI shell
                 port=int(os.environ.get("WEBHOOK_PORT", "9443")),
             )
             log.info("mutating webhook serving on :%s", webhook_server.httpd.server_address[1])
+        elif os.environ.get("KUBERNETES_SERVICE_HOST"):
+            # deployed shape: a MutatingWebhookConfiguration points at this
+            # pod — starting without the webhook would silently bypass
+            # admission (Ignore) or hard-fail every Notebook write (Fail)
+            raise RuntimeError(
+                f"webhook serving certs missing at {cert_dir} "
+                "(is the webhook-server-cert secret mounted?)"
+            )
+        else:
+            log.warning(
+                "WEBHOOK_CERT_DIR %s has no tls.crt: mutating webhook NOT "
+                "served (admission runs only if the cluster calls it)",
+                cert_dir,
+            )
         mgr = build_manager(store, config, leader_election=True)
         log.info("tpu-notebook-controller running (kubeconfig: %s)", store.base_url)
     else:

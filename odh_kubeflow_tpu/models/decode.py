@@ -28,6 +28,7 @@ from .transformer import (
     TransformerConfig,
     layer_post_attention,
     layer_qkv,
+    repeat_kv,
 )
 
 NEG_INF = -1e30
@@ -47,7 +48,8 @@ jax.tree_util.register_dataclass(KVCache, ["k", "v", "length"], [])
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_seq: int) -> KVCache:
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    # kv_heads, not n_heads: the GQA cache-size win lives here
+    shape = (cfg.n_layers, batch, max_seq, cfg.kv_heads, cfg.head_dim)
     return KVCache(
         k=jnp.zeros(shape, cfg.dtype),
         v=jnp.zeros(shape, cfg.dtype),
@@ -75,9 +77,10 @@ def prefill(
     def scan_fn(carry, layer_params):
         h = carry
         q, k, v = layer_qkv(h, layer_params, positions, cfg)
-        attn = _attention(q, k, v, cfg, mesh=None)
+        kr, vr = repeat_kv(k, v, cfg)
+        attn = _attention(q, kr, vr, cfg, mesh=None)
         h = _finish_layer(h, attn, layer_params, cfg)
-        return h, (k, v)
+        return h, (k, v)  # cache the UN-repeated kv heads
 
     x, (ks, vs) = lax.scan(scan_fn, x, params["layers"])
     # place the prompt K/V at cache[:, :, :s]
@@ -110,17 +113,22 @@ def decode_step(
     def scan_fn(carry, inputs):
         h = carry
         layer_params, k_cache, v_cache = inputs
-        q, k, v = layer_qkv(h, layer_params, positions, cfg)  # (b,1,h,hd)
+        q, k, v = layer_qkv(h, layer_params, positions, cfg)  # q: (b,1,h,hd)
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        # grouped attention directly against the kv_heads cache: no repeat,
+        # so the cache read stays n_heads/kv_heads times smaller
+        groups = cfg.n_heads // cfg.kv_heads
+        qg = q.reshape(b, 1, cfg.kv_heads, groups, cfg.head_dim)
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+            "bqcgd,bkcd->bcgqk", qg, k_cache, preferred_element_type=jnp.float32
         ) * (cfg.head_dim**-0.5)
-        scores = jnp.where(valid[None, None, None, :], scores, NEG_INF)
+        scores = jnp.where(valid[None, None, None, None, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum(
-            "bhqk,bkhd->bqhd", probs, v_cache, preferred_element_type=jnp.float32
+            "bcgqk,bkcd->bqcgd", probs, v_cache, preferred_element_type=jnp.float32
         ).astype(cfg.dtype)
+        attn = attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
         h = _finish_layer(h, attn, layer_params, cfg)
         return h, (k_cache, v_cache)
 
@@ -147,6 +155,8 @@ def generate(
     (batch, max_new) new tokens. One compiled program: prefill + a scanned
     decode loop."""
     b, s = prompt.shape
+    if max_new <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
     max_seq = max_seq or (s + max_new)
     if s + max_new > max_seq:
         # dynamic_update_slice CLAMPS out-of-range starts: decoding past the

@@ -48,11 +48,20 @@ class TransformerConfig:
     # Mixture-of-Experts: set to swap every layer's FFN for routed experts
     # (models/moe.py; expert weights shard over the `ep` mesh axis)
     moe: Optional[MoEConfig] = None
+    # Grouped-query attention: number of K/V heads (0 = n_heads, plain MHA).
+    # Shrinks the decode KV cache by n_heads/n_kv_heads
+    n_kv_heads: int = 0
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        assert self.n_heads % kv == 0, "n_heads must be a multiple of n_kv_heads"
+        return kv
 
     @property
     def moe_resolved(self) -> Optional[MoEConfig]:
@@ -100,7 +109,21 @@ def _layer_axes(cfg: TransformerConfig) -> Dict[str, tuple]:
 
 def param_specs(cfg: TransformerConfig, mesh=None):
     """Pytree of PartitionSpec matching init_params' structure."""
-    layers = {k: logical_to_spec(ax, mesh) for k, ax in _layer_axes(cfg).items()}
+    axes = _layer_axes(cfg)
+    layers = {k: logical_to_spec(ax, mesh) for k, ax in axes.items()}
+    if mesh is not None and cfg.kv_heads != cfg.n_heads:
+        # GQA: the fused wqkv head axis is n_heads + 2*kv_heads, which tp may
+        # not divide even when n_heads does (e.g. 32+4 heads on tp=8) —
+        # replicate that axis rather than crash at device_put. The wo/mlp
+        # matmuls keep their tp sharding, so this costs only the projection.
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        fused = cfg.n_heads + 2 * cfg.kv_heads
+        if fused % max(1, sizes.get("tp", 1)):
+            spec = list(layers["wqkv"])
+            spec[2] = None
+            from jax.sharding import PartitionSpec
+
+            layers["wqkv"] = PartitionSpec(*spec)
     top = {k: logical_to_spec(ax, mesh) for k, ax in _TOP_AXES.items()}
     return {**top, "layers": layers}
 
@@ -121,7 +144,7 @@ def init_params(rng, cfg: TransformerConfig):
 
     layers: Dict[str, Any] = {
         "attn_norm": norm_init((L, d)),
-        "wqkv": dense_init(keys[2], (L, d, 3 * h, hd), d),
+        "wqkv": dense_init(keys[2], (L, d, h + 2 * cfg.kv_heads, hd), d),
         "wo": dense_init(keys[3], (L, h, hd, d), d),
         "mlp_norm": norm_init((L, d)),
     }
@@ -178,16 +201,29 @@ def _constrainer(cfg: TransformerConfig, mesh):
 
 def layer_qkv(x, layer_params, positions, cfg: TransformerConfig):
     """Attention-half prelude shared with the decode path (models/decode.py):
-    pre-norm, fused QKV projection, rope. Returns (q, k, v), each
-    (batch, seq, heads, head_dim)."""
+    pre-norm, fused QKV projection, rope. Returns q (batch, seq, n_heads,
+    head_dim) and k/v (batch, seq, kv_heads, head_dim) — GQA configs carry
+    fewer K/V heads."""
     y = rms_norm(x, layer_params["attn_norm"])
     qkv = jnp.einsum(
         "bsd,dnh->bsnh", y, layer_params["wqkv"], preferred_element_type=jnp.float32
     ).astype(cfg.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=2)  # (b, s, h, hd) each
+    h, kv = cfg.n_heads, cfg.kv_heads
+    q, k, v = jnp.split(qkv, [h, h + kv], axis=2)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v
+
+
+def repeat_kv(k, v, cfg: TransformerConfig):
+    """Expand kv_heads -> n_heads for attention kernels that expect equal
+    head counts (flash / ring / reference). The decode path keeps the cache
+    UN-repeated — that is the GQA memory win — and groups inside its einsums
+    instead."""
+    groups = cfg.n_heads // cfg.kv_heads
+    if groups == 1:
+        return k, v
+    return jnp.repeat(k, groups, axis=2), jnp.repeat(v, groups, axis=2)
 
 
 def layer_post_attention(x, attn, layer_params, cfg: TransformerConfig, mesh=None):
@@ -223,6 +259,7 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
     """One pre-norm block. x: (batch, seq, d_model)."""
     constrain = _constrainer(cfg, mesh)
     q, k, v = layer_qkv(x, layer_params, positions, cfg)
+    k, v = repeat_kv(k, v, cfg)
     attn = _attention(q, k, v, cfg, mesh)
     attn = constrain(attn, ("batch", "seq", "heads", "head_dim"))
     return layer_post_attention(x, attn, layer_params, cfg, mesh)
