@@ -114,7 +114,21 @@ def run_sim(args) -> None:
         cluster.add_cpu_pool("load", nodes=max(1, args.notebooks // 8))
         chips_per_nb = 0
 
-    mgr = build_manager(cluster.store, Config(), http_get=cluster.http_get)
+    teardown = []
+    if args.remote:
+        try:
+            store, client = _remote_stack(cluster, Config(), teardown)
+        except Exception:
+            # partial stacks must still tear down (a started TLS server
+            # would otherwise outlive the failure)
+            for fn in reversed(teardown):
+                fn()
+            cluster.stop()
+            raise
+        mgr = build_manager(store, Config(), http_get=cluster.http_get)
+    else:
+        mgr = build_manager(cluster.store, Config(), http_get=cluster.http_get)
+        client = cluster.client
     mgr.start()
     t0 = {}
     try:
@@ -126,7 +140,7 @@ def run_sim(args) -> None:
                 pvc=not args.no_pvc,
             ):
                 t0[name] = time.monotonic()
-                cluster.client.create(default_scheme.decode(doc))
+                client.create(default_scheme.decode(doc))
         storm_s = time.monotonic() - created
 
         latencies = {}
@@ -134,7 +148,7 @@ def run_sim(args) -> None:
         pending = {f"{args.prefix}{i}" for i in range(args.notebooks)}
         while pending and time.monotonic() < deadline:
             for name in list(pending):
-                nb = cluster.client.get(Notebook, args.namespace, name)
+                nb = client.get(Notebook, args.namespace, name)
                 ready = (
                     nb.status.tpu.mesh_ready
                     if (args.accelerator and nb.status.tpu)
@@ -146,10 +160,13 @@ def run_sim(args) -> None:
             time.sleep(0.005)
     finally:
         mgr.stop()
+        for fn in reversed(teardown):
+            fn()
         cluster.stop()
 
     vals = sorted(latencies.values())
     result = {
+        "transport": "remote (wire protocol, TLS)" if args.remote else "in-process",
         "notebooks": args.notebooks,
         "ready": len(vals),
         "timed_out": args.notebooks - len(vals),
@@ -168,6 +185,64 @@ def run_sim(args) -> None:
         raise SystemExit(1)
 
 
+def _remote_stack(cluster, config, teardown):
+    """TLS apiserver + HTTPS admission webhook around the sim; returns the
+    RemoteStore the manager runs on and a typed Client for the storm."""
+    import base64
+    import tempfile
+
+    from odh_kubeflow_tpu.api.admission import (
+        MutatingWebhook,
+        MutatingWebhookConfiguration,
+        RuleWithOperations,
+        WebhookClientConfig,
+    )
+    from odh_kubeflow_tpu.cluster import ApiServer, Client, RemoteStore, WebhookDispatcher
+    from odh_kubeflow_tpu.controllers import NotebookWebhook
+    from odh_kubeflow_tpu.runtime.webhook_server import WebhookServer
+    from odh_kubeflow_tpu.utils.certs import generate_cert_dir
+
+    import shutil
+
+    pki = tempfile.mkdtemp(prefix="loadtest-pki-")
+    teardown.append(lambda: shutil.rmtree(pki, ignore_errors=True))
+    ca, crt, key = generate_cert_dir(pki)
+    with open(ca, "rb") as f:
+        ca_b64 = base64.b64encode(f.read()).decode()
+    api = ApiServer(
+        cluster.store,
+        bearer_token="loadtest",
+        certfile=crt,
+        keyfile=key,
+        admission=WebhookDispatcher(cluster.store),
+    ).start()
+    teardown.append(api.stop)
+    store = RemoteStore(api.base_url, token="loadtest", ca_file=ca, timeout=30)
+    wh = WebhookServer(certfile=crt, keyfile=key).start()
+    teardown.append(wh.stop)
+    wh.register("/mutate-notebook-v1", NotebookWebhook(Client(store), config).handle)
+    cfg = MutatingWebhookConfiguration()
+    cfg.metadata.name = "notebook-mutator"
+    cfg.webhooks = [
+        MutatingWebhook(
+            name="notebooks.kubeflow.org",
+            client_config=WebhookClientConfig(
+                url=f"{wh.base_url}/mutate-notebook-v1", ca_bundle=ca_b64
+            ),
+            rules=[
+                RuleWithOperations(
+                    operations=["CREATE", "UPDATE"],
+                    api_groups=["kubeflow.org"],
+                    api_versions=["*"],
+                    resources=["notebooks"],
+                )
+            ],
+        )
+    ]
+    Client(cluster.store).create(cfg)
+    return store, Client(store)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--notebooks", type=int, default=3)  # reference default
@@ -179,6 +254,11 @@ def main() -> None:
     ap.add_argument("--no-pvc", action="store_true")
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--emit", action="store_true", help="print CR YAML and exit")
+    ap.add_argument(
+        "--remote",
+        action="store_true",
+        help="run the manager over the wire-protocol apiserver (TLS + webhook)",
+    )
     args = ap.parse_args()
     if args.accelerator in ("", "none", "cpu"):
         args.accelerator = ""

@@ -405,8 +405,10 @@ class RemoteStore:
             try:
                 with open(self.token_file) as f:
                     token = f.read().strip() or self.token
+                self.token = token  # cache the last good read: a mid-refresh
+                # failure must fall back to the freshest token, not boot-time
             except OSError:
-                token = self.token  # keep the last known token (mid-refresh)
+                token = self.token
         if token:
             headers["Authorization"] = f"Bearer {token}"
         return headers

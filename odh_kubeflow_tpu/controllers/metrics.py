@@ -9,11 +9,14 @@ from __future__ import annotations
 from typing import Optional
 
 from ..api.apps import StatefulSet
+from ..api.core import Node
 from ..api.notebook import Notebook
 from ..cluster.client import Client
 from ..runtime.metrics import Registry
 from ..tpu import TPU_RESOURCE
 from . import constants as C
+
+_GKE_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 
 
 class NotebookMetrics:
@@ -45,19 +48,33 @@ class NotebookMetrics:
             "Notebook CR to slice-ready latency (the north-star metric)",
             buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300),
         )
+        # fleet capacity, per accelerator type (from Node allocatable — the
+        # TPU analog of cluster GPU-capacity dashboards)
+        self.tpu_chips_allocatable = registry.gauge(
+            "tpu_chips_allocatable",
+            "TPU chips allocatable in the cluster, by accelerator",
+            labels=("accelerator",),
+        )
+        self._seen_accelerators: set = set()
         if client is not None:
             registry.add_collector(self._scrape)
 
     def _scrape(self) -> None:
         """Pull-style collector: list StatefulSets at scrape time (reference
-        Metrics.scrape :82-99) and aggregate running notebooks + bound chips."""
+        Metrics.scrape :82-99) and aggregate running notebooks + bound chips,
+        plus fleet chip capacity from Node allocatable."""
         assert self.client is not None
         running = 0
         chips = 0
         for sts in self.client.list(StatefulSet):
             if C.NOTEBOOK_NAME_LABEL not in sts.spec.template.metadata.labels:
                 continue
-            if sts.metadata.labels.get(C.NOTEBOOK_NAME_LABEL) != sts.metadata.name:
+            owner_nb = sts.metadata.labels.get(C.NOTEBOOK_NAME_LABEL, "")
+            # STS names are the CLAMPED form of the notebook name. Deferred
+            # import: notebook.py imports this module at load time
+            from .notebook import statefulset_name
+
+            if statefulset_name(owner_nb) != sts.metadata.name:
                 continue
             ready = sts.status.ready_replicas
             if ready > 0:
@@ -67,3 +84,25 @@ class NotebookMetrics:
                     chips += ready * int(float(c.resources.requests[TPU_RESOURCE]))
         self.notebook_running.set(running)
         self.tpu_chips_bound.set(chips)
+
+        capacity: dict = {}
+        try:
+            nodes = self.client.list(Node)
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning("capacity scrape: Node list failed: %r", e)
+            return  # keep last values rather than zeroing on a transient error
+        for node in nodes:
+            alloc = (node.status.allocatable or {}).get(TPU_RESOURCE)
+            if not alloc:
+                continue
+            accel = node.metadata.labels.get(_GKE_ACCELERATOR_LABEL, "unknown")
+            capacity[accel] = capacity.get(accel, 0) + int(float(alloc))
+        for accel, total in sorted(capacity.items()):
+            self.tpu_chips_allocatable.set(total, accelerator=accel)
+        # zero series for accelerator types that left the cluster — stale
+        # phantom capacity must not outlive its nodes
+        for accel in self._seen_accelerators - set(capacity):
+            self.tpu_chips_allocatable.set(0, accelerator=accel)
+        self._seen_accelerators |= set(capacity)

@@ -116,7 +116,11 @@ def main() -> None:  # pragma: no cover - thin CLI shell
                 port=int(os.environ.get("WEBHOOK_PORT", "9443")),
             )
             log.info("mutating webhook serving on :%s", webhook_server.httpd.server_address[1])
-        elif os.environ.get("KUBERNETES_SERVICE_HOST"):
+        elif os.environ.get("KUBERNETES_SERVICE_HOST") and not os.environ.get(
+            "KUBECONFIG"
+        ):
+            # (an explicit KUBECONFIG override may legitimately run in a pod
+            # without webhook certs — only the DEPLOYED shape must fail hard)
             # deployed shape: a MutatingWebhookConfiguration points at this
             # pod — starting without the webhook would silently bypass
             # admission (Ignore) or hard-fail every Notebook write (Fail)
