@@ -186,60 +186,12 @@ def run_sim(args) -> None:
 
 
 def _remote_stack(cluster, config, teardown):
-    """TLS apiserver + HTTPS admission webhook around the sim; returns the
-    RemoteStore the manager runs on and a typed Client for the storm."""
-    import base64
-    import tempfile
+    """The shared wire-protocol stack (cluster/remote_fixture.py): TLS
+    apiserver + HTTPS admission webhook around the sim's store."""
+    from odh_kubeflow_tpu.cluster import Client
+    from odh_kubeflow_tpu.cluster.remote_fixture import build_remote_stack
 
-    from odh_kubeflow_tpu.api.admission import (
-        MutatingWebhook,
-        MutatingWebhookConfiguration,
-        RuleWithOperations,
-        WebhookClientConfig,
-    )
-    from odh_kubeflow_tpu.cluster import ApiServer, Client, RemoteStore, WebhookDispatcher
-    from odh_kubeflow_tpu.controllers import NotebookWebhook
-    from odh_kubeflow_tpu.runtime.webhook_server import WebhookServer
-    from odh_kubeflow_tpu.utils.certs import generate_cert_dir
-
-    import shutil
-
-    pki = tempfile.mkdtemp(prefix="loadtest-pki-")
-    teardown.append(lambda: shutil.rmtree(pki, ignore_errors=True))
-    ca, crt, key = generate_cert_dir(pki)
-    with open(ca, "rb") as f:
-        ca_b64 = base64.b64encode(f.read()).decode()
-    api = ApiServer(
-        cluster.store,
-        bearer_token="loadtest",
-        certfile=crt,
-        keyfile=key,
-        admission=WebhookDispatcher(cluster.store),
-    ).start()
-    teardown.append(api.stop)
-    store = RemoteStore(api.base_url, token="loadtest", ca_file=ca, timeout=30)
-    wh = WebhookServer(certfile=crt, keyfile=key).start()
-    teardown.append(wh.stop)
-    wh.register("/mutate-notebook-v1", NotebookWebhook(Client(store), config).handle)
-    cfg = MutatingWebhookConfiguration()
-    cfg.metadata.name = "notebook-mutator"
-    cfg.webhooks = [
-        MutatingWebhook(
-            name="notebooks.kubeflow.org",
-            client_config=WebhookClientConfig(
-                url=f"{wh.base_url}/mutate-notebook-v1", ca_bundle=ca_b64
-            ),
-            rules=[
-                RuleWithOperations(
-                    operations=["CREATE", "UPDATE"],
-                    api_groups=["kubeflow.org"],
-                    api_versions=["*"],
-                    resources=["notebooks"],
-                )
-            ],
-        )
-    ]
-    Client(cluster.store).create(cfg)
+    _, store, _ = build_remote_stack(cluster.store, config, teardown, token="loadtest")
     return store, Client(store)
 
 

@@ -1,0 +1,83 @@
+"""Shared wire-protocol stack builder: TLS ApiServer + HTTPS admission
+webhook + RemoteStore around an existing Store.
+
+One definition for every consumer that needs "the deployed shape without a
+cluster" — the remote e2e suite and the loadtest's --remote mode — so the
+admission path they exercise can never drift apart. Returns the RemoteStore
+the manager should run on; appends cleanup callables to `teardown` (run them
+in reverse) as each piece starts, so a partially-built stack still tears
+down when a later step fails.
+"""
+from __future__ import annotations
+
+import base64
+import shutil
+import tempfile
+from typing import Any, Callable, List, Tuple
+
+from .store import Store
+
+
+def build_remote_stack(
+    store: Store,
+    config,
+    teardown: List[Callable[[], None]],
+    token: str = "wire-token",
+) -> Tuple[Any, Any, Any]:
+    """Returns (api_server, remote_store, webhook_server)."""
+    from ..api.admission import (
+        MutatingWebhook,
+        MutatingWebhookConfiguration,
+        RuleWithOperations,
+        WebhookClientConfig,
+    )
+    from ..controllers import NotebookWebhook
+    from ..runtime.webhook_server import WebhookServer
+    from ..utils.certs import generate_cert_dir
+    from .apiserver import ApiServer
+    from .client import Client
+    from .remote import RemoteStore
+    from .webhook_dispatch import WebhookDispatcher
+
+    pki = tempfile.mkdtemp(prefix="remote-stack-pki-")
+    teardown.append(lambda: shutil.rmtree(pki, ignore_errors=True))
+    ca, crt, key = generate_cert_dir(pki)
+    with open(ca, "rb") as f:
+        ca_b64 = base64.b64encode(f.read()).decode()
+
+    api = ApiServer(
+        store,
+        bearer_token=token,
+        certfile=crt,
+        keyfile=key,
+        admission=WebhookDispatcher(store),
+    ).start()
+    teardown.append(api.stop)
+    remote = RemoteStore(api.base_url, token=token, ca_file=ca, timeout=30)
+
+    webhook_server = WebhookServer(certfile=crt, keyfile=key).start()
+    teardown.append(webhook_server.stop)
+    webhook_server.register(
+        "/mutate-notebook-v1", NotebookWebhook(Client(remote), config).handle
+    )
+    cfg = MutatingWebhookConfiguration()
+    cfg.metadata.name = "notebook-mutator"
+    cfg.webhooks = [
+        MutatingWebhook(
+            name="notebooks.kubeflow.org",
+            client_config=WebhookClientConfig(
+                url=f"{webhook_server.base_url}/mutate-notebook-v1",
+                ca_bundle=ca_b64,
+            ),
+            rules=[
+                RuleWithOperations(
+                    operations=["CREATE", "UPDATE"],
+                    api_groups=["kubeflow.org"],
+                    api_versions=["*"],
+                    resources=["notebooks"],
+                )
+            ],
+        )
+    ]
+    Client(remote).create(cfg)
+    return api, remote, webhook_server

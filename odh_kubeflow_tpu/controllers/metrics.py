@@ -64,16 +64,17 @@ class NotebookMetrics:
         Metrics.scrape :82-99) and aggregate running notebooks + bound chips,
         plus fleet chip capacity from Node allocatable."""
         assert self.client is not None
+        # deferred import (notebook.py imports this module at load time),
+        # once per scrape
+        from .notebook import statefulset_name
+
         running = 0
         chips = 0
         for sts in self.client.list(StatefulSet):
             if C.NOTEBOOK_NAME_LABEL not in sts.spec.template.metadata.labels:
                 continue
             owner_nb = sts.metadata.labels.get(C.NOTEBOOK_NAME_LABEL, "")
-            # STS names are the CLAMPED form of the notebook name. Deferred
-            # import: notebook.py imports this module at load time
-            from .notebook import statefulset_name
-
+            # STS names are the CLAMPED form of the notebook name
             if statefulset_name(owner_nb) != sts.metadata.name:
                 continue
             ready = sts.status.ready_replicas

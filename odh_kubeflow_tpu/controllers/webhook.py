@@ -95,6 +95,7 @@ class NotebookWebhook:
             user_podspec = copy.deepcopy(nb.spec.template.spec.to_dict())
 
             if req.operation == "CREATE":
+                self.validate_name(nb)
                 self.inject_reconciliation_lock(nb)
 
             self.validate_tpu(nb, span)
@@ -246,6 +247,19 @@ class NotebookWebhook:
         nb.metadata.annotations.setdefault(
             C.STOP_ANNOTATION, C.RECONCILIATION_LOCK_VALUE
         )
+
+    def validate_name(self, nb: Notebook) -> None:
+        """Names longer than a DNS label cannot materialize: the ClusterIP
+        Service shares the notebook's name (reference generateService
+        :525-552 — same constraint there) and pod DNS addressing rides it.
+        Fail at admission with a clear message instead of letting the
+        reconciler crash-loop on Service creation."""
+        if len(nb.metadata.name) > 63:
+            raise AdmissionDeniedError(
+                f"metadata.name {nb.metadata.name!r} is {len(nb.metadata.name)} "
+                "chars; notebook names must be <= 63 (DNS label: the Service "
+                "and per-pod DNS share the name)"
+            )
 
     def validate_tpu(self, nb: Notebook, span) -> None:
         if nb.spec.tpu is None or not nb.spec.tpu.accelerator:

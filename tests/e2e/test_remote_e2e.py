@@ -12,36 +12,21 @@ Reference anchors: managers connect via ctrl.GetConfigOrDie
 (notebook-controller/main.go:79-94); webhook served over TLS
 (odh main.go:213-227, suite_test.go:120-246).
 """
-import base64
 import time
 
 import pytest
 
-from odh_kubeflow_tpu.api.admission import (
-    MutatingWebhook,
-    MutatingWebhookConfiguration,
-    RuleWithOperations,
-    WebhookClientConfig,
-)
 from odh_kubeflow_tpu.api.apps import StatefulSet
 from odh_kubeflow_tpu.api.core import Container, Service
 from odh_kubeflow_tpu.api.gateway import HTTPRoute
 from odh_kubeflow_tpu.api.networking import NetworkPolicy
 from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
 from odh_kubeflow_tpu.apimachinery import NotFoundError
-from odh_kubeflow_tpu.cluster import (
-    ApiServer,
-    Client,
-    RemoteStore,
-    SimCluster,
-    WebhookDispatcher,
-)
-from odh_kubeflow_tpu.controllers import Config, NotebookWebhook
+from odh_kubeflow_tpu.cluster import Client, SimCluster
+from odh_kubeflow_tpu.controllers import Config
 from odh_kubeflow_tpu.controllers import constants as C
 from odh_kubeflow_tpu.main import build_manager
 from odh_kubeflow_tpu.probe import sim_agent_behavior
-from odh_kubeflow_tpu.runtime.webhook_server import WebhookServer
-from odh_kubeflow_tpu.utils.certs import generate_cert_dir
 
 CTRL_NS = "tpu-notebooks-system"
 NS = "remote-user"
@@ -81,19 +66,6 @@ def ctx(tmp_path_factory):
     agents = {}
     cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.8))
 
-    pki = tmp_path_factory.mktemp("remote-pki")
-    ca, crt, key = generate_cert_dir(str(pki))
-    with open(ca, "rb") as f:
-        ca_b64 = base64.b64encode(f.read()).decode()
-
-    api = ApiServer(
-        cluster.store,
-        bearer_token="e2e-token",
-        certfile=crt,
-        keyfile=key,
-        admission=WebhookDispatcher(cluster.store),
-    ).start()
-
     config = Config(
         controller_namespace=CTRL_NS,
         enable_culling=True,
@@ -102,41 +74,22 @@ def ctx(tmp_path_factory):
         set_pipeline_rbac=True,
     )
 
-    # ---- manager side: everything over the wire from here on
-    remote = RemoteStore(
-        api.base_url, token="e2e-token", ca_file=ca, timeout=10
+    # ---- manager side: everything over the wire from here on, via the
+    # SHARED stack builder (same admission path as loadtest --remote)
+    from odh_kubeflow_tpu.cluster.remote_fixture import build_remote_stack
+
+    teardown = []
+    _, remote, _ = build_remote_stack(
+        cluster.store, config, teardown, token="e2e-token"
     )
-    webhook_server = WebhookServer(certfile=crt, keyfile=key).start()
-    webhook_server.register(
-        "/mutate-notebook-v1", NotebookWebhook(Client(remote), config).handle
-    )
-    cfg = MutatingWebhookConfiguration()
-    cfg.metadata.name = "notebook-mutator"
-    cfg.webhooks = [
-        MutatingWebhook(
-            name="notebooks.kubeflow.org",
-            client_config=WebhookClientConfig(
-                url=f"{webhook_server.base_url}/mutate-notebook-v1", ca_bundle=ca_b64
-            ),
-            rules=[
-                RuleWithOperations(
-                    operations=["CREATE", "UPDATE"],
-                    api_groups=["kubeflow.org"],
-                    api_versions=["*"],
-                    resources=["notebooks"],
-                )
-            ],
-        )
-    ]
-    Client(remote).create(cfg)
 
     mgr = build_manager(remote, config, http_get=cluster.http_get)
     mgr.start()
     client = Client(remote)
     yield cluster, client, agents
     mgr.stop()
-    webhook_server.stop()
-    api.stop()
+    for fn in reversed(teardown):
+        fn()
     cluster.stop()
 
 

@@ -5,7 +5,7 @@ import time
 
 import pytest
 
-from odh_kubeflow_tpu.api.core import Container, Pod, ResourceRequirements
+from odh_kubeflow_tpu.api.core import Container, Pod
 from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
 from odh_kubeflow_tpu.cluster import PodDecision, SimCluster
 from odh_kubeflow_tpu.controllers import (
@@ -180,44 +180,3 @@ def test_probe_failure_defers_culling(env):
     assert C.STOP_ANNOTATION not in nb.metadata.annotations
     assert C.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION in nb.metadata.annotations
 
-
-def test_metrics_scrape_counts_clamped_sts_and_capacity():
-    """The running-notebook scrape matches clamped STS names (long notebook
-    names must still count) and reports per-accelerator chip capacity from
-    Node allocatable."""
-    from odh_kubeflow_tpu.api.apps import StatefulSet
-    from odh_kubeflow_tpu.api.core import Node
-    from odh_kubeflow_tpu.cluster import Client, Store
-    from odh_kubeflow_tpu.controllers.metrics import NotebookMetrics
-    from odh_kubeflow_tpu.controllers.notebook import statefulset_name
-    from odh_kubeflow_tpu.runtime.metrics import Registry
-
-    store = Store()
-    client = Client(store)
-    long_name = "wb-" + "y" * 60
-    sts = StatefulSet()
-    sts.metadata.name = statefulset_name(long_name)
-    sts.metadata.namespace = "u"
-    sts.metadata.labels = {C.NOTEBOOK_NAME_LABEL: long_name}
-    sts.spec.template.metadata.labels = {C.NOTEBOOK_NAME_LABEL: long_name}
-    sts.spec.template.spec.containers = [
-        Container(name="c", image="i", resources=ResourceRequirements(
-            requests={"google.com/tpu": "4"}))
-    ]
-    client.create(sts)
-    created = client.get(StatefulSet, "u", sts.metadata.name)
-    created.status.ready_replicas = 1
-    client.update_status(created)
-
-    node = Node()
-    node.metadata.name = "tpu-node-0"
-    node.metadata.labels = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice"}
-    node.status.allocatable = {"google.com/tpu": "4"}
-    client.create(node)
-
-    registry = Registry()
-    metrics = NotebookMetrics(registry, client)
-    rendered = registry.render()
-    assert "notebook_running_total 1" in rendered
-    assert "notebook_tpu_chips_bound 4" in rendered
-    assert 'tpu_chips_allocatable{accelerator="tpu-v5-lite-podslice"} 4' in rendered

@@ -493,3 +493,16 @@ def test_elyra_flat_secret_fallback_still_works():
     cfg = json.loads(secret.string_data["odh_dsp.json"])
     assert cfg["metadata"]["api_endpoint"] == "https://flat:8443"
     assert cfg["metadata"]["cos_bucket"] == "b"
+
+
+def test_name_longer_than_dns_label_rejected_at_admission():
+    """A 64+ char name can never materialize (the Service shares it);
+    admission rejects with a clear message instead of a reconciler
+    crash-loop. 63 chars passes (STS/route clamping handles the rest)."""
+    store = Store()
+    client = Client(store)
+    NotebookWebhook(client, Config()).register(store)
+    too_long = "n" * 64
+    with pytest.raises(AdmissionDeniedError, match="63"):
+        client.create(mk_nb(too_long))
+    client.create(mk_nb("n" * 63))  # boundary OK
