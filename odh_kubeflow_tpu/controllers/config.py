@@ -36,6 +36,11 @@ class Config:
     # device-visibility readiness gate (controllers/probe_status.py): poll
     # cadence for /tpu/readiness until the mesh gate is green
     readiness_probe_period_s: float = 10.0
+    # MaxConcurrentReconciles analog: worker threads per controller. The
+    # workqueue's per-key single-flight makes >1 safe; under create storms
+    # (and over the higher-latency remote transport) it is the difference
+    # between serial and pipelined reconciles
+    max_concurrent_reconciles: int = 4
 
     # extension controller / webhook (reference odh main.go + webhook consts)
     auth_proxy_image: str = "kube-rbac-proxy:latest"
@@ -69,4 +74,10 @@ class Config:
         )
         if os.environ.get("READINESS_PROBE_PERIOD_S"):
             c.readiness_probe_period_s = float(os.environ["READINESS_PROBE_PERIOD_S"])
+        if os.environ.get("MAX_CONCURRENT_RECONCILES"):
+            # clamp: 0/negative would spawn no workers and silently disable
+            # every controller
+            c.max_concurrent_reconciles = max(
+                1, int(os.environ["MAX_CONCURRENT_RECONCILES"])
+            )
         return c

@@ -66,7 +66,9 @@ class CullingReconciler:
         if not self.config.enable_culling:
             log.info("culling disabled (ENABLE_CULLING not set)")
             return
-        self.manager.builder("culling").for_(Notebook).complete(self.reconcile)
+        self.manager.builder("culling").for_(Notebook).with_workers(
+            self.config.max_concurrent_reconciles
+        ).complete(self.reconcile)
 
     # ---------- URLs ----------
 

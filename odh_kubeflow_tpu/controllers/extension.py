@@ -130,6 +130,7 @@ class TPUWorkbenchReconciler:
             .owns(RoleBinding)
             .watches(HTTPRoute, map_route)
             .watches(ConfigMap, map_ca_source)
+            .with_workers(self.config.max_concurrent_reconciles)
             .complete(self.reconcile)
         )
 

@@ -113,6 +113,7 @@ class NotebookReconciler:
             .owns(StatefulSet)
             .owns(Service)
             .watches(Pod, map_pod, predicate=pod_is_labeled)
+            .with_workers(self.config.max_concurrent_reconciles)
             .complete(self.reconcile)
         )
 

@@ -57,7 +57,9 @@ class ProbeStatusController:
         self.metrics = metrics or NotebookMetrics(manager.metrics)
 
     def setup(self) -> None:
-        self.manager.builder("probe-status").for_(Notebook).complete(self.reconcile)
+        self.manager.builder("probe-status").for_(Notebook).with_workers(
+            self.config.max_concurrent_reconciles
+        ).complete(self.reconcile)
 
     # ---------- probing ----------
 

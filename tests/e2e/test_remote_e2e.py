@@ -58,7 +58,7 @@ def gone(fn, timeout=TIMEOUT, msg="gone"):
 
 
 @pytest.fixture(scope="module")
-def ctx(tmp_path_factory):
+def ctx():
     # ---- cluster side: sim nodes/kubelet/agents + the API server over TLS
     cluster = SimCluster().start()
     cluster.add_cpu_pool("cpu", nodes=2)
@@ -79,12 +79,18 @@ def ctx(tmp_path_factory):
     from odh_kubeflow_tpu.cluster.remote_fixture import build_remote_stack
 
     teardown = []
-    _, remote, _ = build_remote_stack(
-        cluster.store, config, teardown, token="e2e-token"
-    )
-
-    mgr = build_manager(remote, config, http_get=cluster.http_get)
-    mgr.start()
+    try:
+        _, remote, _ = build_remote_stack(
+            cluster.store, config, teardown, token="e2e-token"
+        )
+        mgr = build_manager(remote, config, http_get=cluster.http_get)
+        mgr.start()
+    except Exception:
+        # a partially-started TLS stack must not outlive a failed fixture
+        for fn in reversed(teardown):
+            fn()
+        cluster.stop()
+        raise
     client = Client(remote)
     yield cluster, client, agents
     mgr.stop()
