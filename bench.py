@@ -34,6 +34,7 @@ import statistics
 import time
 
 V5E_PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e chip
+V5E_HBM_GBPS = 819  # HBM bandwidth, TPU v5e chip (GB/s)
 
 SINGLE_HOST_NOTEBOOKS = 16  # v5e-4 each
 MULTI_HOST_NOTEBOOKS = 4  # v5p-32 each (4 hosts x 4 chips)
@@ -196,7 +197,13 @@ def bench_train_step():
 
 def bench_decode():
     """KV-cache autoregressive decoding: tokens/s for a whole generate call
-    (prefill + scanned decode loop, ONE compiled program)."""
+    (prefill + scanned decode loop, ONE compiled program).
+
+    Completion is a host scalar fetch, NOT block_until_ready — through the
+    per-dispatch tunnel block_until_ready can return before the program
+    finishes (observed: absurd token rates). Each timed call also carries a
+    fixed ~100ms tunnel round-trip; the full-generate minus prefill-only
+    subtraction cancels it, so decode_per_token_ms is net device time."""
     import jax
     import jax.numpy as jnp
 
@@ -216,22 +223,43 @@ def bench_decode():
     batch, prompt_len, max_new = 8, 128, 128
     params = init_params(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab)
-    out = generate(params, prompt, cfg, max_new=max_new)  # compile + warm
-    jax.block_until_ready(out)
-    jax.block_until_ready(generate(params, prompt, cfg, max_new=1, max_seq=prompt_len + max_new))
-    t0 = time.perf_counter()
-    out = generate(params, prompt, cfg, max_new=max_new)
-    jax.block_until_ready(out)
-    elapsed = time.perf_counter() - t0
-    # separate the prefill so per-decode-token cost is not inflated by it
-    t0 = time.perf_counter()
-    jax.block_until_ready(generate(params, prompt, cfg, max_new=1, max_seq=prompt_len + max_new))
-    prefill_s = time.perf_counter() - t0
+
+    def fetch(x):
+        int(jnp.sum(x))  # host fetch = true completion
+
+    def run_full():
+        fetch(generate(params, prompt, cfg, max_new=max_new))
+
+    def run_prefill():
+        fetch(generate(params, prompt, cfg, max_new=1, max_seq=prompt_len + max_new))
+
+    run_full()  # compile + warm
+    run_prefill()
+    fulls, prefills = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_full()
+        fulls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_prefill()
+        prefills.append(time.perf_counter() - t0)
+    elapsed = statistics.median(fulls)
+    prefill_s = statistics.median(prefills)
     decode_s = max(elapsed - prefill_s, 1e-9)
+    # per-step HBM floor: every decode token re-reads all params + the cache.
+    # The embed table doesn't stream — decode gathers `batch` rows — so it's
+    # excluded (unembed DOES stream through the logits matmul).
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    n_streamed = n_params - params["embed"].size
+    bytes_per_step = 2 * n_streamed + 2 * 2 * cfg.n_layers * batch * (
+        prompt_len + max_new
+    ) * cfg.kv_heads * cfg.head_dim
+    hbm_util = bytes_per_step / (decode_s / (max_new - 1)) / V5E_HBM_GBPS / 1e9
     return {
         "generate_tokens_per_s": round(batch * max_new / elapsed),
         "decode_only_tokens_per_s": round(batch * (max_new - 1) / decode_s),
         "decode_per_token_ms": round(decode_s / (max_new - 1) * 1e3, 2),
+        "hbm_util_est": round(hbm_util, 3),
         "prefill_ms": round(prefill_s * 1e3, 1),
         "batch": batch,
         "prompt_len": prompt_len,

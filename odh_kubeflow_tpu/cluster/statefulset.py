@@ -21,6 +21,7 @@ from typing import Optional
 from ..api.apps import StatefulSet
 from ..api.core import Pod
 from ..apimachinery import AlreadyExistsError, NotFoundError, ignore_not_found
+from .client import retry_on_conflict
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 
@@ -91,18 +92,28 @@ class StatefulSetController:
                 self._try(lambda name=pod.metadata.name: self.client.delete(Pod, req.namespace, name))
             )
 
-        cur = self.client.get(StatefulSet, req.namespace, req.name)
-        if (
-            cur.status.replicas != running
-            or cur.status.ready_replicas != ready
-            or cur.status.observed_generation != cur.metadata.generation
-        ):
-            cur.status.replicas = running
-            cur.status.ready_replicas = ready
-            cur.status.current_replicas = running
-            cur.status.updated_replicas = running
-            cur.status.observed_generation = cur.metadata.generation
-            self.client.update_status(cur)
+        def write_status():
+            # re-GET inside the retry: concurrent reconcilers racing the
+            # notebook controller's status mirror made a blind
+            # read-modify-write conflict-crash here (retry.RetryOnConflict
+            # at every multi-writer site — SURVEY §5)
+            try:
+                cur = self.client.get(StatefulSet, req.namespace, req.name)
+            except NotFoundError:
+                return
+            if (
+                cur.status.replicas != running
+                or cur.status.ready_replicas != ready
+                or cur.status.observed_generation != cur.metadata.generation
+            ):
+                cur.status.replicas = running
+                cur.status.ready_replicas = ready
+                cur.status.current_replicas = running
+                cur.status.updated_replicas = running
+                cur.status.observed_generation = cur.metadata.generation
+                self.client.update_status(cur)
+
+        retry_on_conflict(write_status)
         return None
 
     def _try(self, fn):

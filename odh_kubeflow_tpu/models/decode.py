@@ -12,6 +12,20 @@ cf. bench.py's tunnel note).
 Decode attention is deliberately the einsum path, not the pallas kernel: a
 1-token query is HBM-bandwidth-bound (reading the cache), with no O(s²)
 score matrix to avoid.
+
+Decode is roofline-bound by HBM reads (params + cache once per token), so the
+generate loop is laid out to touch nothing else:
+
+- **Layers unrolled, weights pre-sliced.** A `lax.scan` over stacked layer
+  params dynamic-slices (= copies) every layer's weights out of the stack on
+  every token. The loop body instead closes over per-layer views sliced ONCE
+  before the scan — loop-invariant, so each token re-reads the same buffers.
+- **Per-layer cache buffers in the carry.** Stacked (L, ...) caches threaded
+  through an inner scan as xs/ys cost a full cache copy per token (ys
+  re-stacking). Separate (k, v) buffers per layer live in the token-scan
+  carry, where XLA aliases the one-token `dynamic_update_slice` in place.
+- **Grouped-query attention reads the un-repeated cache** (kv_heads wide —
+  the GQA HBM win) by folding the group axis into the einsums.
 """
 from __future__ import annotations
 
@@ -62,20 +76,36 @@ def _finish_layer(x, attn, layer_params, cfg: TransformerConfig):
     return out
 
 
-def prefill(
-    params, tokens: jnp.ndarray, cfg: TransformerConfig, max_seq: int
-) -> Tuple[jnp.ndarray, KVCache]:
-    """Run the prompt, returning last-position logits and the primed cache.
-    tokens: (batch, prompt_len); prompt_len <= max_seq."""
+def _cached_attention(q, k_cache, v_cache, valid, cfg: TransformerConfig):
+    """One query token against the cache. q: (b, 1, n_heads, head_dim);
+    k/v_cache: (b, max_seq, kv_heads, head_dim); valid: (max_seq,) bool.
+    Grouped attention directly against the kv_heads cache: no repeat, so the
+    cache read stays n_heads/kv_heads times smaller."""
+    b = q.shape[0]
+    groups = cfg.n_heads // cfg.kv_heads
+    qg = q.reshape(b, 1, cfg.kv_heads, groups, cfg.head_dim)
+    scores = jnp.einsum(
+        "bqcgd,bkcd->bcgqk", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (cfg.head_dim**-0.5)
+    scores = jnp.where(valid[None, None, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum(
+        "bcgqk,bkcd->bqcgd", probs, v_cache, preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    return attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
+
+
+def _prompt_scan(params, tokens: jnp.ndarray, cfg: TransformerConfig):
+    """Shared prompt forward: last-position logits plus the stacked
+    (L, b, s, kv_heads, head_dim) K/V — flash attention does the O(s²) work.
+    prefill and _prefill_parts differ only in how they package the K/V."""
     from .transformer import _attention
 
     b, s = tokens.shape
-    cache = init_cache(cfg, b, max_seq)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
     x = params["embed"].astype(cfg.dtype)[tokens]
 
-    def scan_fn(carry, layer_params):
-        h = carry
+    def scan_fn(h, layer_params):
         q, k, v = layer_qkv(h, layer_params, positions, cfg)
         kr, vr = repeat_kv(k, v, cfg)
         attn = _attention(q, kr, vr, cfg, mesh=None)
@@ -83,15 +113,26 @@ def prefill(
         return h, (k, v)  # cache the UN-repeated kv heads
 
     x, (ks, vs) = lax.scan(scan_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, -1], params["unembed"], preferred_element_type=jnp.float32
+    )
+    return logits, ks, vs
+
+
+def prefill(
+    params, tokens: jnp.ndarray, cfg: TransformerConfig, max_seq: int
+) -> Tuple[jnp.ndarray, KVCache]:
+    """Run the prompt, returning last-position logits and the primed cache.
+    tokens: (batch, prompt_len); prompt_len <= max_seq."""
+    b, s = tokens.shape
+    logits, ks, vs = _prompt_scan(params, tokens, cfg)
+    cache = init_cache(cfg, b, max_seq)
     # place the prompt K/V at cache[:, :, :s]
     cache = KVCache(
         k=lax.dynamic_update_slice(cache.k, ks, (0, 0, 0, 0, 0)),
         v=lax.dynamic_update_slice(cache.v, vs, (0, 0, 0, 0, 0)),
         length=jnp.asarray(s, jnp.int32),
-    )
-    x = rms_norm(x, params["final_norm"])
-    logits = jnp.einsum(
-        "bd,dv->bv", x[:, -1], params["unembed"], preferred_element_type=jnp.float32
     )
     return logits, cache
 
@@ -101,7 +142,10 @@ def decode_step(
 ) -> Tuple[jnp.ndarray, KVCache]:
     """One token for the whole batch: token (batch,) int32 at position
     cache.length. Returns next-token logits (batch, vocab) and the updated
-    cache."""
+    cache.
+
+    This is the convenient stacked-cache single-step API; the generate loop
+    uses the unrolled per-layer-buffer layout instead (see module docstring)."""
     b = token.shape[0]
     pos = cache.length  # scalar
     positions = jnp.broadcast_to(pos[None, None], (b, 1)).astype(jnp.int32)
@@ -116,19 +160,7 @@ def decode_step(
         q, k, v = layer_qkv(h, layer_params, positions, cfg)  # q: (b,1,h,hd)
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-        # grouped attention directly against the kv_heads cache: no repeat,
-        # so the cache read stays n_heads/kv_heads times smaller
-        groups = cfg.n_heads // cfg.kv_heads
-        qg = q.reshape(b, 1, cfg.kv_heads, groups, cfg.head_dim)
-        scores = jnp.einsum(
-            "bqcgd,bkcd->bcgqk", qg, k_cache, preferred_element_type=jnp.float32
-        ) * (cfg.head_dim**-0.5)
-        scores = jnp.where(valid[None, None, None, None, :], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum(
-            "bcgqk,bkcd->bqcgd", probs, v_cache, preferred_element_type=jnp.float32
-        ).astype(cfg.dtype)
-        attn = attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
+        attn = _cached_attention(q, k_cache, v_cache, valid, cfg)
         h = _finish_layer(h, attn, layer_params, cfg)
         return h, (k_cache, v_cache)
 
@@ -141,7 +173,77 @@ def decode_step(
     return logits, cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "max_seq", "temperature"))
+def _prefill_parts(params, tokens, cfg: TransformerConfig, max_seq: int):
+    """Prompt forward returning last-position logits and PER-LAYER cache
+    buffers ((b, max_seq, kv_heads, head_dim) each) — the generate-loop
+    layout (separate buffers alias in the token-scan carry)."""
+    b, s = tokens.shape
+    logits, ks, vs = _prompt_scan(params, tokens, cfg)
+    shape = (b, max_seq, cfg.kv_heads, cfg.head_dim)
+    caches = tuple(
+        (
+            lax.dynamic_update_slice(jnp.zeros(shape, cfg.dtype), ks[l], (0, 0, 0, 0)),
+            lax.dynamic_update_slice(jnp.zeros(shape, cfg.dtype), vs[l], (0, 0, 0, 0)),
+        )
+        for l in range(cfg.n_layers)
+    )
+    return logits, caches
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "max_seq", "sample"))
+def _generate_impl(params, prompt, rng, temperature, cfg, max_new, max_seq, sample):
+    b, s = prompt.shape
+    logits, caches = _prefill_parts(params, prompt, cfg, max_seq)
+    # per-layer weight views, sliced ONCE (loop-invariant: every decode step
+    # re-reads these buffers instead of re-slicing the (L, ...) stack)
+    layers = [
+        jax.tree_util.tree_map(lambda a, l=l: a[l], params["layers"])
+        for l in range(cfg.n_layers)
+    ]
+
+    def pick(step_logits, key):
+        if sample:
+            # temperature is a TRACED operand: new temperatures don't
+            # recompile the whole prefill+decode program
+            return jax.random.categorical(key, step_logits / temperature, axis=-1)
+        return jnp.argmax(step_logits, axis=-1)
+
+    # one split up front: reusing rng for the first pick AND as the parent of
+    # the scan keys would correlate the first sample with the rest
+    all_keys = jax.random.split(rng, max_new + 1)
+    first = pick(logits, all_keys[0])
+    pos0 = jnp.asarray(s, jnp.int32)
+
+    def scan_fn(carry, key):
+        token, pos, caches = carry
+        positions = jnp.broadcast_to(pos[None, None], (b, 1)).astype(jnp.int32)
+        x = params["embed"].astype(cfg.dtype)[token][:, None, :]
+        valid = jnp.arange(max_seq) <= pos
+        new_caches = []
+        for layer_params, (k_cache, v_cache) in zip(layers, caches):
+            q, k, v = layer_qkv(x, layer_params, positions, cfg)
+            k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+            v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+            attn = _cached_attention(q, k_cache, v_cache, valid, cfg)
+            x = _finish_layer(x, attn, layer_params, cfg)
+            new_caches.append((k_cache, v_cache))
+        x = rms_norm(x, params["final_norm"])
+        step_logits = jnp.einsum(
+            "bd,dv->bv", x[:, 0], params["unembed"],
+            preferred_element_type=jnp.float32,
+        )
+        nxt = pick(step_logits, key)
+        return (nxt, pos + 1, tuple(new_caches)), token
+
+    # max_new - 1 steps: the scan emits its INPUT token each iteration, so
+    # a max_new-length scan would run one whole discarded decode step
+    (last, _, _), tokens = lax.scan(
+        scan_fn, (first, pos0, caches), all_keys[1:max_new]
+    )
+    tokens = jnp.concatenate([jnp.moveaxis(tokens, 0, 1), last[:, None]], axis=1)
+    return tokens  # (batch, max_new)
+
+
 def generate(
     params,
     prompt: jnp.ndarray,
@@ -153,7 +255,8 @@ def generate(
 ) -> jnp.ndarray:
     """Greedy (temperature 0) or sampled generation: (batch, prompt_len) ->
     (batch, max_new) new tokens. One compiled program: prefill + a scanned
-    decode loop."""
+    decode loop. Only greedy-vs-sampled is a compile-time switch; the
+    temperature VALUE is a runtime operand."""
     b, s = prompt.shape
     if max_new <= 0:
         return jnp.zeros((b, 0), jnp.int32)
@@ -164,27 +267,15 @@ def generate(
         raise ValueError(
             f"prompt ({s}) + max_new ({max_new}) exceeds cache max_seq ({max_seq})"
         )
-    logits, cache = prefill(params, prompt, cfg, max_seq)
-
-    def pick(logits, key):
-        if temperature > 0.0:
-            return jax.random.categorical(key, logits / temperature, axis=-1)
-        return jnp.argmax(logits, axis=-1)
-
+    sample = temperature > 0.0
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    # one split up front: reusing rng for the first pick AND as the parent of
-    # the scan keys would correlate the first sample with the rest
-    all_keys = jax.random.split(rng, max_new + 1)
-    first = pick(logits, all_keys[0])
-
-    def scan_fn(carry, key):
-        token, cache = carry
-        logits, cache = decode_step(params, cache, token, cfg)
-        nxt = pick(logits, key)
-        return (nxt, cache), token
-
-    # max_new - 1 steps: the scan emits its INPUT token each iteration, so
-    # a max_new-length scan would run one whole discarded decode step
-    (last, _), tokens = lax.scan(scan_fn, (first, cache), all_keys[1:max_new])
-    tokens = jnp.concatenate([jnp.moveaxis(tokens, 0, 1), last[:, None]], axis=1)
-    return tokens  # (batch, max_new)
+    return _generate_impl(
+        params,
+        prompt,
+        rng,
+        jnp.asarray(temperature, jnp.float32),
+        cfg,
+        max_new,
+        max_seq,
+        sample,
+    )
