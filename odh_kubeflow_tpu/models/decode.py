@@ -42,7 +42,6 @@ from .transformer import (
     TransformerConfig,
     layer_post_attention,
     layer_qkv,
-    repeat_kv,
 )
 
 NEG_INF = -1e30
@@ -95,6 +94,18 @@ def _cached_attention(q, k_cache, v_cache, valid, cfg: TransformerConfig):
     return attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
 
 
+def _decode_layer(h, layer_params, k_cache, v_cache, positions, valid, pos, cfg):
+    """One layer of single-token decode, shared between decode_step's scanned
+    stacked-cache path and the generate loop's unrolled per-buffer path: QKV
+    for the new token, in-place cache update at `pos`, grouped attention
+    against the cache, projection + MLP."""
+    q, k, v = layer_qkv(h, layer_params, positions, cfg)  # q: (b,1,h,hd)
+    k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    attn = _cached_attention(q, k_cache, v_cache, valid, cfg)
+    return _finish_layer(h, attn, layer_params, cfg), k_cache, v_cache
+
+
 def _prompt_scan(params, tokens: jnp.ndarray, cfg: TransformerConfig):
     """Shared prompt forward: last-position logits plus the stacked
     (L, b, s, kv_heads, head_dim) K/V — flash attention does the O(s²) work.
@@ -107,10 +118,10 @@ def _prompt_scan(params, tokens: jnp.ndarray, cfg: TransformerConfig):
 
     def scan_fn(h, layer_params):
         q, k, v = layer_qkv(h, layer_params, positions, cfg)
-        kr, vr = repeat_kv(k, v, cfg)
-        attn = _attention(q, kr, vr, cfg, mesh=None)
+        # flash/mha consume the GQA kv heads natively — no expansion
+        attn = _attention(q, k, v, cfg, mesh=None)
         h = _finish_layer(h, attn, layer_params, cfg)
-        return h, (k, v)  # cache the UN-repeated kv heads
+        return h, (k, v)
 
     x, (ks, vs) = lax.scan(scan_fn, x, params["layers"])
     x = rms_norm(x, params["final_norm"])
@@ -157,11 +168,9 @@ def decode_step(
     def scan_fn(carry, inputs):
         h = carry
         layer_params, k_cache, v_cache = inputs
-        q, k, v = layer_qkv(h, layer_params, positions, cfg)  # q: (b,1,h,hd)
-        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-        attn = _cached_attention(q, k_cache, v_cache, valid, cfg)
-        h = _finish_layer(h, attn, layer_params, cfg)
+        h, k_cache, v_cache = _decode_layer(
+            h, layer_params, k_cache, v_cache, positions, valid, pos, cfg
+        )
         return h, (k_cache, v_cache)
 
     x, (ks, vs) = lax.scan(scan_fn, x, (params["layers"], cache.k, cache.v))
@@ -221,11 +230,9 @@ def _generate_impl(params, prompt, rng, temperature, cfg, max_new, max_seq, samp
         valid = jnp.arange(max_seq) <= pos
         new_caches = []
         for layer_params, (k_cache, v_cache) in zip(layers, caches):
-            q, k, v = layer_qkv(x, layer_params, positions, cfg)
-            k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-            v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-            attn = _cached_attention(q, k_cache, v_cache, valid, cfg)
-            x = _finish_layer(x, attn, layer_params, cfg)
+            x, k_cache, v_cache = _decode_layer(
+                x, layer_params, k_cache, v_cache, positions, valid, pos, cfg
+            )
             new_caches.append((k_cache, v_cache))
         x = rms_norm(x, params["final_norm"])
         step_logits = jnp.einsum(

@@ -171,7 +171,12 @@ def init_params(rng, cfg: TransformerConfig):
 
 
 def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
+    """k/v may carry kv_heads < n_heads: the flash kernel and mha_reference
+    consume GQA natively (K/V never expanded — the HBM win applies on the
+    training path too). Only the ring path expands, its per-shard einsum
+    wants equal head counts."""
     if cfg.seq_axis and mesh is not None:
+        k, v = repeat_kv(k, v, cfg)
         # ppermute needs bound axis names: run the ring under shard_map over
         # the FULL mesh; only `sp` collectives occur, other axes stay local.
         spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), mesh)
@@ -216,10 +221,10 @@ def layer_qkv(x, layer_params, positions, cfg: TransformerConfig):
 
 
 def repeat_kv(k, v, cfg: TransformerConfig):
-    """Expand kv_heads -> n_heads for attention kernels that expect equal
-    head counts (flash / ring / reference). The decode path keeps the cache
-    UN-repeated — that is the GQA memory win — and groups inside its einsums
-    instead."""
+    """Expand kv_heads -> n_heads for the ring-attention path, whose
+    per-shard einsum expects equal head counts. The flash kernel and
+    mha_reference consume GQA natively, and the decode path keeps the cache
+    UN-repeated — that is the GQA memory win."""
     groups = cfg.n_heads // cfg.kv_heads
     if groups == 1:
         return k, v
@@ -259,7 +264,6 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
     """One pre-norm block. x: (batch, seq, d_model)."""
     constrain = _constrainer(cfg, mesh)
     q, k, v = layer_qkv(x, layer_params, positions, cfg)
-    k, v = repeat_kv(k, v, cfg)
     attn = _attention(q, k, v, cfg, mesh)
     attn = constrain(attn, ("batch", "seq", "heads", "head_dim"))
     return layer_post_attention(x, attn, layer_params, cfg, mesh)

@@ -1,11 +1,25 @@
-"""Flash attention as a pallas TPU kernel.
+"""Flash attention as a pallas TPU kernel — GQA-native, work-balanced causal.
 
 The framework's hottest op: O(seq²) score matrices never materialize in HBM.
-Grid is (batch*heads, q_blocks, k_blocks); K/V stream through VMEM one
-(block_k, head_dim) tile per step while the online-softmax carry (m, l, acc)
-rides VMEM scratch across the innermost k dimension, so usable sequence
-length is bounded by HBM, not VMEM. Causal grid steps above the diagonal
-skip their compute (the diagonal block masks elementwise).
+
+Layout: q is viewed as (batch·kv_heads, group, seq, d) where group =
+n_heads // n_kv_heads, K/V as (batch·kv_heads, seq, d) — K/V are NEVER
+expanded to the full head count (that would forfeit exactly the HBM savings
+GQA exists for). The grid walks (bh, q_row, group, k_block); the q tile
+stays VMEM-resident across the whole K stream and the online-softmax carry
+(m, l, acc) rides VMEM scratch across the innermost k dimension, so usable
+sequence length is bounded by HBM, not VMEM.
+
+Causal work balancing: a naive rectangular grid wastes ~half its steps above
+the diagonal — skipped compute still pays the per-step pipeline cost
+(measured ~25% of causal runtime at 8k). Instead, each grid row PAIRS query
+block i with query block N-1-i: row i contributes i+1 valid K blocks and its
+partner N-i, so every grid row runs exactly N+1 fully-useful steps. The
+online-softmax carry re-initializes at the intra-row switch. Diagonal blocks
+mask elementwise; all other blocks skip the iota/where mask (VPU work
+comparable to the exp itself). Scores live in the log2 domain (exp2 is the
+VPU primitive; ln2 folds into the score scale). The same scheme drives the
+backward kernels, with the dk/dv triangle paired in reverse.
 
 Off-TPU (CPU tests, the 8-device virtual mesh) the jnp reference path is used
 — same math, f32 accumulation — keeping unit tests hardware-independent while
@@ -27,66 +41,179 @@ except Exception:  # pragma: no cover
     _HAVE_PALLAS = False
 
 NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # kernels fold ln->log2 into the score scale
+LN2 = 0.6931471805599453
 
 
 def mha_reference(q, k, v, causal: bool = True, q_offset: int = 0, kv_offset: int = 0):
-    """Reference attention. q: (b, sq, h, d); k/v: (b, sk, h, d). Offsets give
-    the global positions of the local q/k windows (ring-attention shards)."""
-    scale = q.shape[-1] ** -0.5
+    """Reference attention, GQA-aware. q: (b, sq, h, d); k/v: (b, sk, hk, d)
+    with h a multiple of hk. Offsets give the global positions of the local
+    q/k windows (ring-attention shards)."""
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    g = h // hk
+    scale = d**-0.5
+    qg = q.reshape(b, sq, hk, g, d)
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqkgd,bnkd->bkgqn", qg, k, preferred_element_type=jnp.float32
     ) * scale
     if causal:
-        qpos = q_offset + jnp.arange(q.shape[1])
+        qpos = q_offset + jnp.arange(sq)
         kpos = kv_offset + jnp.arange(k.shape[1])
         mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+        "bkgqn,bnkd->bqkgd", p, v, preferred_element_type=jnp.float32
     )
-    return out.astype(q.dtype)
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Grid geometry helpers
+#
+# "Balanced" mode (causal, block_q == block_k, num_qb == num_kb even): grid
+# row i2 serves query blocks a = i2 and b = N-1-i2 over an inner dimension of
+# N+1 steps — steps j <= i2 are (a, k=j), the rest are (b, k=j-1-i2). Every
+# step does useful work. Fallback ("clamped") mode keeps a rectangular grid
+# and elides the DMA of skipped steps by clamping index maps to the diagonal
+# (pallas skips the copy when consecutive steps map to the same block).
+# ---------------------------------------------------------------------------
+
+
+def _diag_mask(qi, ki, block_q, block_k, balanced):
+    """Causal mask for a diagonal-straddling block. In balanced mode
+    block_q == block_k and masked blocks sit exactly ON the diagonal
+    (qi == ki), so the mask is a CONSTANT relative pattern — no dynamic
+    program-id offsets, and Mosaic hoists the iota comparison out of the
+    grid loop."""
+    rq = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    rk = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    if balanced:
+        return rq >= rk
+    return qi * block_q + rq >= ki * block_k + rk
+
+
+def _use_balanced(causal, block_q, block_k, num_qb, num_kb):
+    return (
+        causal
+        and block_q == block_k
+        and num_qb == num_kb
+        and num_qb % 2 == 0
+        and num_qb >= 2
+    )
+
+
+def _balanced_qk(i2, j, num_qb):
+    in_a = j <= i2
+    qi = jnp.where(in_a, i2, num_qb - 1 - i2)
+    ki = jnp.where(in_a, j, j - 1 - i2)
+    return qi, ki
+
+
+def _row_bounds(balanced, i, j, num_kb):
+    """(is_init, is_emit) for the forward/dq grids: a balanced row serves two
+    q blocks, so the carry re-initializes and emits twice per row."""
+    if balanced:
+        return (j == 0) | (j == i + 1), (j == i) | (j == num_kb)
+    return j == 0, j == num_kb - 1
+
+
+def _causal_dispatch(fold, causal, balanced, qi, ki, block_q, block_k):
+    """Run fold(masked) for this grid step: unmasked fast path strictly below
+    the diagonal, elementwise mask on diagonal-straddling blocks, nothing
+    above it (dead steps exist only in the fallback grid — balanced grids
+    visit none)."""
+    if not causal:
+        return fold(False)
+    diag = (ki + 1) * block_k - 1 > qi * block_q
+    if balanced:
+        pl.when(diag)(lambda: fold(True))
+        pl.when(jnp.logical_not(diag))(lambda: fold(False))
+    else:
+        valid = ki * block_k < (qi + 1) * block_q
+        pl.when(valid & diag)(lambda: fold(True))
+        pl.when(valid & jnp.logical_not(diag))(lambda: fold(False))
+
+
+def _fwd_maps(balanced, causal, block_q, block_k, num_qb, num_kb):
+    """(q/o/lse index map, k/v index map) for the forward/dq grid
+    (bh, row, group, inner)."""
+    if balanced:
+
+        def q_map(bh, i2, g, j):
+            qi, _ = _balanced_qk(i2, j, num_qb)
+            return (bh, g, qi, 0)
+
+        def kv_map(bh, i2, g, j):
+            _, ki = _balanced_qk(i2, j, num_qb)
+            return (bh, ki, 0)
+
+        return q_map, kv_map
+
+    def q_map(bh, i, g, j):
+        return (bh, g, i, 0)
+
+    if causal:
+
+        def kv_map(bh, i, g, j):
+            jmax = ((i + 1) * block_q - 1) // block_k
+            return (bh, jnp.minimum(j, jmax), 0)
+
+    else:
+
+        def kv_map(bh, i, g, j):
+            return (bh, j, 0)
+
+    return q_map, kv_map
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
 
 
 def _flash_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, block_q: int, block_k: int, causal: bool, sm_scale: float, num_kb: int,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+    num_qb: int, num_kb: int, balanced: bool,
 ):
-    """Grid (batch*heads, q_blocks, k_blocks); K/V stream one (block_k, d)
-    tile per step while the online-softmax carry (m, l, acc) lives in VMEM
-    scratch across the innermost (k) grid dimension. m/l are stored
-    lane-broadcast (block_q, 128) so the scratch keeps TPU-native tiling."""
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    """m/l are stored lane-broadcast (block_q, 128) so the scratch keeps
+    TPU-native tiling."""
+    i = pl.program_id(1)
+    j = pl.program_id(3)
+    qi, ki = _balanced_qk(i, j, num_qb) if balanced else (i, j)
+    is_init, is_emit = _row_bounds(balanced, i, j, num_kb)
 
-    @pl.when(ki == 0)
+    @pl.when(is_init)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    def _fold():
+    def _fold(masked):
         # Inputs stay in their native (bf16) dtype so the MXU runs at full
-        # rate; accumulation is f32 via preferred_element_type. The scale is
-        # applied to the f32 scores, not the operands.
+        # rate; accumulation is f32 via preferred_element_type. VPU economy:
+        # scores live in the log2 domain — exp2 is the hardware primitive,
+        # and folding log2(e) into the score scale saves a full-block
+        # multiply. (Moving the row-sum onto the MXU was measured SLOWER:
+        # the MXU is the busier unit at these block shapes.)
         s = jax.lax.dot_general(
-            q_ref[0],
+            q_ref[0, 0],
             k_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale  # (block_q, block_k)
-        if causal:
-            qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        ) * (sm_scale * LOG2E)  # (block_q, block_k), log2-domain
+        if masked:
+            s = jnp.where(_diag_mask(qi, ki, block_q, block_k, balanced), s, NEG_INF)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype),  # bf16 PV matmul, f32 accumulate (standard flash)
+            p.astype(v_ref.dtype),  # bf16 PV matmul, f32 accumulate
             v_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -94,16 +221,11 @@ def _flash_kernel(
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    if causal:
-        # K blocks entirely above the diagonal fold nothing; their compute
-        # (not their DMA) is skipped. The diagonal block masks elementwise.
-        pl.when(ki * block_k < (qi + 1) * block_q)(_fold)
-    else:
-        _fold()
+    _causal_dispatch(_fold, causal, balanced, qi, ki, block_q, block_k)
 
-    @pl.when(ki == num_kb - 1)
+    @pl.when(is_emit)
     def _emit():
-        o_ref[0] = (
+        o_ref[0, 0] = (
             acc_ref[...] / jnp.maximum(l_ref[:, :1], 1e-30)
         ).astype(o_ref.dtype)
         if lse_ref is not None:
@@ -112,9 +234,10 @@ def _flash_kernel(
             # Lane-broadcast (block_q, 128) like the m/l carries: row stats
             # live in sublane orientation and Mosaic cannot cheaply
             # transpose them
-            lse_ref[0] = jnp.broadcast_to(
-                m_ref[:, :1] + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30)),
-                lse_ref.shape[1:],
+            lse_ref[0, 0] = jnp.broadcast_to(
+                # m is log2-domain; lse is emitted in natural log
+                m_ref[:, :1] * LN2 + jnp.log(jnp.maximum(l_ref[:, :1], 1e-30)),
+                lse_ref.shape[2:],
             )
 
 
@@ -137,31 +260,36 @@ def flash_attention(
     k,
     v,
     causal: bool = True,
-    block_q: int = 512,
+    block_q: int = 1024,
     block_k: int = 1024,
     interpret: bool = False,
 ):
-    """Fused attention. q/k/v: (batch, seq, heads, head_dim). Dispatches to
-    the pallas kernel on TPU (or interpret=True anywhere); otherwise the XLA
-    reference path.
+    """Fused attention. q: (batch, seq, heads, head_dim); k/v: (batch, seq,
+    kv_heads, head_dim) with heads % kv_heads == 0 — GQA runs natively, K/V
+    are never expanded. Dispatches to the pallas kernel on TPU (or
+    interpret=True anywhere); otherwise the XLA reference path.
 
-    Default blocks (512, 1024) are measured on v5e: grid-step overhead falls
-    quadratically with block area, and these keep q/k/v tiles + the f32 carry
-    comfortably inside VMEM (q 128K + k/v 256K×2(double-buffer) + acc 256K).
-    Blocks clamp to the largest power-of-two divisor of the sequence, so
-    short sequences still hit the kernel."""
+    Default blocks (1024, 1024) are measured on v5e (112 TF/s at 8k causal
+    before balancing): equal q/k blocks enable the balanced-causal grid, and
+    the tiles + f32 carry stay within the 16 MB VMEM scoped limit (2048-wide
+    q blocks OOM once the lse output joins). Blocks clamp to the largest
+    power-of-two divisor of the sequence, so short sequences still hit the
+    kernel."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    hk = k.shape[2]
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     on_tpu = jax.default_backend() == "tpu"
     use_kernel = (
         _HAVE_PALLAS
         and (on_tpu or interpret)
+        and h % hk == 0
         and sq % block_q == 0
         and sk % block_k == 0
         and block_q >= 8
         and block_k >= 128
+        and (not causal or sq == sk)
     )
     if not use_kernel:
         return mha_reference(q, k, v, causal=causal)
@@ -175,7 +303,9 @@ def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
     pallas_call has no JVP rule, so training would fail at value_and_grad
     without this. The forward saves (q, k, v, out, lse); the backward is the
     blockwise FlashAttention-2 recompute (_flash_backward) — O(s) HBM end to
-    end, so long-context training keeps the flash memory advantage."""
+    end, so long-context training keeps the flash memory advantage. For GQA,
+    dk/dv are accumulated over the q-head group inside the kernel — the
+    gradient of the (implicit) broadcast."""
     return _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret)
 
 
@@ -201,31 +331,57 @@ def _compiler_params(pltpu, semantics):
         return None
 
 
+def _to_grouped(q, hk):
+    """(b, s, h, d) -> (b*hk, group, s, d). Head j attends kv-head j//group
+    (matching models/transformer.repeat_kv's jnp.repeat convention)."""
+    b, s, h, d = q.shape
+    g = h // hk
+    return q.transpose(0, 2, 1, 3).reshape(b, hk, g, s, d).reshape(b * hk, g, s, d)
+
+
+def _from_grouped(x, b, h):
+    bhk, g, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
 def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret, with_lse=False):
     b, sq, h, d = q.shape
-    sk = k.shape[1]
-    # (b, s, h, d) -> (b*h, s, d): one grid row per (batch, head)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    sk, hk = k.shape[1], k.shape[2]
+    group = h // hk
+    qt = _to_grouped(q, hk)  # (b*hk, group, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hk, sk, d)
 
     from jax.experimental.pallas import tpu as pltpu
 
+    num_qb = sq // block_q
     num_kb = sk // block_k
+    balanced = _use_balanced(causal, block_q, block_k, num_qb, num_kb)
+    grid = (
+        (b * hk, num_qb // 2, group, num_kb + 1)
+        if balanced
+        else (b * hk, num_qb, group, num_kb)
+    )
     kernel = functools.partial(
         _flash_kernel,
         block_q=block_q,
         block_k=block_k,
         causal=causal,
         sm_scale=d**-0.5,
+        num_qb=num_qb,
         num_kb=num_kb,
+        balanced=balanced,
     )
-    out_specs = [pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
+    q_map, kv_map = _fwd_maps(balanced, causal, block_q, block_k, num_qb, num_kb)
+    qo_spec = pl.BlockSpec((1, 1, block_q, d), q_map)
+    out_specs = [qo_spec]
+    out_shape = [jax.ShapeDtypeStruct((b * hk, group, sq, d), q.dtype)]
     if with_lse:
         # lane-broadcast row stats (see _flash_kernel._emit)
-        out_specs.append(pl.BlockSpec((1, block_q, 128), lambda bh, i, j: (bh, i, 0)))
-        out_shape.append(jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1, block_q, 128), q_map))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b * hk, group, sq, 128), jnp.float32)
+        )
     else:
         # inference-only forwards must not pay an extra HBM write: a pallas
         # output cannot be dead-code-eliminated by XLA, so the lse ref is
@@ -235,16 +391,11 @@ def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret, with_lse
         def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
             full(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref, acc_ref)
 
+    kv_spec = pl.BlockSpec((1, block_k, d), kv_map)
     outs = pl.pallas_call(
         kernel,
-        grid=(b * h, sq // block_q, num_kb),
-        in_specs=[
-            # q's index map ignores ki -> pallas keeps the block resident
-            # across the whole K stream (no re-DMA)
-            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-        ],
+        grid=grid,
+        in_specs=[qo_spec, kv_spec, kv_spec],
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -252,12 +403,14 @@ def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret, with_lse
             pltpu.VMEM((block_q, 128), jnp.float32),  # l (lane-broadcast)
             pltpu.VMEM((block_q, d), jnp.float32),  # acc
         ],
-        compiler_params=_compiler_params(pltpu, ("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary", "arbitrary")
+        ),
         interpret=interpret,
     )(qt, kt, vt)
-    out = outs[0].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    out = _from_grouped(outs[0], b, h)
     if with_lse:
-        return out, outs[1]  # lse stays in (b*h, sq, 128) kernel layout
+        return out, outs[1]  # lse stays in (b*hk, group, sq, 128) kernel layout
     return out
 
 
@@ -268,48 +421,50 @@ def _flash_forward_kernel(q, k, v, causal, block_q, block_k, interpret, with_lse
 #   p  = exp(s − lse)            probabilities, exactly the forward's
 #   dp = do vᵀ                   (block_q, block_k) f32
 #   ds = p ⊙ (dp − delta)·scale  where delta = rowsum(do ⊙ o)
-# dq accumulates over k-blocks; dk/dv accumulate over q-blocks. Contractions
-# over dim 0 (pᵀ·do, dsᵀ·q) are expressed directly in dot_general — Mosaic
-# lowers them without materialized transposes.
+# dq accumulates over k-blocks; dk/dv accumulate over q-blocks AND the GQA
+# q-head group. Contractions over dim 0 (pᵀ·do, dsᵀ·q) are expressed directly
+# in dot_general — Mosaic lowers them without materialized transposes.
 # ---------------------------------------------------------------------------
 
 
 def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    qi, ki, block_q, block_k, causal, sm_scale):
+                    qi, ki, block_q, block_k, causal, sm_scale, masked,
+                    balanced=False):
     s = jax.lax.dot_general(
-        q_ref[0], k_ref[0],
+        q_ref[0, 0], k_ref[0],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * sm_scale
-    if causal:
-        qpos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        kpos = ki * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0][:, :1])
+    ) * (sm_scale * LOG2E)  # log2-domain, like the forward
+    if masked:
+        s = jnp.where(_diag_mask(qi, ki, block_q, block_k, balanced), s, NEG_INF)
+    p = jnp.exp2(s - lse_ref[0, 0][:, :1] * LOG2E)
     dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0],
+        do_ref[0, 0], v_ref[0],
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta_ref[0][:, :1]) * sm_scale
+    ds = p * (dp - delta_ref[0, 0][:, :1]) * sm_scale
     return p, ds
 
 
 def _flash_bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, block_q: int, block_k: int, causal: bool, sm_scale: float, num_kb: int,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+    num_qb: int, num_kb: int, balanced: bool,
 ):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    i = pl.program_id(1)
+    j = pl.program_id(3)
+    qi, ki = _balanced_qk(i, j, num_qb) if balanced else (i, j)
+    is_init, is_emit = _row_bounds(balanced, i, j, num_kb)
 
-    @pl.when(ki == 0)
+    @pl.when(is_init)
     def _init():
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def _fold():
+    def _fold(masked):
         _, ds = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            qi, ki, block_q, block_k, causal, sm_scale,
+            qi, ki, block_q, block_k, causal, sm_scale, masked, balanced,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -317,126 +472,179 @@ def _flash_bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        pl.when(ki * block_k < (qi + 1) * block_q)(_fold)
-    else:
-        _fold()
+    _causal_dispatch(_fold, causal, balanced, qi, ki, block_q, block_k)
 
-    @pl.when(ki == num_kb - 1)
+    @pl.when(is_emit)
     def _emit():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _balanced_kv_qi(j2, t, num_qb, num_kb):
+    """dkv pairing: grid row j2 serves k rows a = j2 (q blocks j2..N-1) and
+    b = N-1-j2 (q blocks N-1-j2..N-1) over num_qb+1 inner steps."""
+    in_a = t < num_qb - j2
+    ki = jnp.where(in_a, j2, num_kb - 1 - j2)
+    qi = jnp.where(in_a, j2 + t, t - 1)
+    return ki, qi, in_a
 
 
 def _flash_bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
-    *, block_q: int, block_k: int, causal: bool, sm_scale: float, num_qb: int,
+    *, block_q: int, block_k: int, causal: bool, sm_scale: float,
+    num_qb: int, num_kb: int, group: int, balanced: bool,
 ):
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    """Grid (bh, k_row, q_steps, group) — group INNERMOST so each k row's
+    accumulation over (q blocks × group) completes contiguously and K/V stay
+    VMEM-resident across the entire inner sweep (one HBM read per k block).
+    dk/dv accumulate over both inner dimensions (the GQA broadcast
+    gradient)."""
+    j2 = pl.program_id(1)
+    t = pl.program_id(2)
+    gi = pl.program_id(3)
+    if balanced:
+        ki, qi, in_a = _balanced_kv_qi(j2, t, num_qb, num_kb)
+        row_start = (t == 0) | (t == num_qb - j2)
+        row_end = (t == num_qb - j2 - 1) | (t == num_qb)
+    else:
+        ki, qi = j2, t
+        row_start = t == 0
+        row_end = t == num_qb - 1
 
-    @pl.when(qi == 0)
+    @pl.when(row_start & (gi == 0))
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def _fold():
+    def _fold(masked):
         p, ds = _recompute_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            qi, ki, block_q, block_k, causal, sm_scale,
+            qi, ki, block_q, block_k, causal, sm_scale, masked, balanced,
         )
         dv_acc[...] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0],
+            p.astype(do_ref.dtype), do_ref[0, 0],
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dk_acc[...] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0],
+            ds.astype(q_ref.dtype), q_ref[0, 0],
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    if causal:
-        # q blocks entirely above the diagonal contribute nothing to this
-        # k block (no qpos >= kpos pair)
-        pl.when((qi + 1) * block_q > ki * block_k)(_fold)
-    else:
-        _fold()
+    # in the fallback grid, q blocks entirely above the diagonal contribute
+    # nothing to this k block; their input DMA is elided by the clamped maps
+    _causal_dispatch(_fold, causal, balanced, qi, ki, block_q, block_k)
 
-    @pl.when(qi == num_qb - 1)
+    @pl.when(row_end & (gi == group - 1))
     def _emit():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _dkv_maps(balanced, causal, block_q, block_k, num_qb, num_kb):
+    """(q/do/lse/delta index map, k/v/dk/dv index map) for the dkv grid
+    (bh, k_row, q_steps, group)."""
+    if balanced:
+
+        def row_map(bh, j2, t, g):
+            _, qi, _ = _balanced_kv_qi(j2, t, num_qb, num_kb)
+            return (bh, g, qi, 0)
+
+        def kv_map(bh, j2, t, g):
+            ki, _, _ = _balanced_kv_qi(j2, t, num_qb, num_kb)
+            return (bh, ki, 0)
+
+        return row_map, kv_map
+
+    if causal:
+
+        def row_map(bh, j, t, g):
+            # clamp pre-diagonal steps to the first contributing q block:
+            # their DMA is elided and the first valid step's block is
+            # already loaded
+            imin = (j * block_k) // block_q
+            return (bh, g, jnp.maximum(t, imin), 0)
+
+    else:
+
+        def row_map(bh, j, t, g):
+            return (bh, g, t, 0)
+
+    def kv_map(bh, j, t, g):
+        return (bh, j, 0)
+
+    return row_map, kv_map
+
+
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hk = k.shape[1], k.shape[2]
+    group = h // hk
     # smaller blocks than forward: the recompute holds several (bq, bk) f32
-    # intermediates live at once
-    bq = _fit_block(min(block_q, 256), sq)
+    # intermediates live at once; equal sizes keep the balanced grid
+    bq = _fit_block(min(block_q, 512), sq)
     bk = _fit_block(min(block_k, 512), sk)
-    bh = b * h
+    bh = b * hk
 
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(bh, -1, d)
-
-    qt, kt, vt, ot, gt = map(to_bh, (q, k, v, out, g))
+    qt, ot, gt = (_to_grouped(x, hk) for x in (q, out, g))
+    kt = k.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(bh, sk, d)
     # delta = rowsum(do ⊙ o), lane-broadcast to the lse layout
     delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
-    delta = jnp.broadcast_to(delta[..., None], (bh, sq, 128))
+    delta = jnp.broadcast_to(delta[..., None], (bh, group, sq, 128))
 
     from jax.experimental.pallas import tpu as pltpu
 
     sm_scale = d**-0.5
     num_qb = sq // bq
     num_kb = sk // bk
+    balanced = _use_balanced(causal, bq, bk, num_qb, num_kb)
 
-    row_specs = {
-        "q": pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, i, 0)),
-        "lse": pl.BlockSpec((1, bq, 128), lambda bhi, i, j: (bhi, i, 0)),
-        "kcol": pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, j, 0)),
-    }
+    q_map, kv_map = _fwd_maps(balanced, causal, bq, bk, num_qb, num_kb)
+    q_spec = pl.BlockSpec((1, 1, bq, d), q_map)
+    stat_spec = pl.BlockSpec((1, 1, bq, 128), q_map)
+    kv_spec = pl.BlockSpec((1, bk, d), kv_map)
+    dq_grid = (
+        (bh, num_qb // 2, group, num_kb + 1)
+        if balanced
+        else (bh, num_qb, group, num_kb)
+    )
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel,
-            block_q=bq, block_k=bk, causal=causal, sm_scale=sm_scale, num_kb=num_kb,
+            block_q=bq, block_k=bk, causal=causal, sm_scale=sm_scale,
+            num_qb=num_qb, num_kb=num_kb, balanced=balanced,
         ),
-        grid=(bh, num_qb, num_kb),
-        in_specs=[
-            row_specs["q"],  # q
-            row_specs["kcol"],  # k
-            row_specs["kcol"],  # v
-            row_specs["q"],  # do
-            row_specs["lse"],  # lse
-            row_specs["lse"],  # delta
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        grid=dq_grid,
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, group, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=_compiler_params(pltpu, ("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary", "arbitrary")
+        ),
         interpret=interpret,
     )(qt, kt, vt, gt, lse, delta)
 
-    # dkv grid: k blocks outer, q blocks inner (accumulate over q)
+    row_map, kvc_map = _dkv_maps(balanced, causal, bq, bk, num_qb, num_kb)
+    row_spec = pl.BlockSpec((1, 1, bq, d), row_map)
+    rstat_spec = pl.BlockSpec((1, 1, bq, 128), row_map)
+    kvc_spec = pl.BlockSpec((1, bk, d), kvc_map)
+    dkv_grid = (
+        (bh, num_kb // 2, num_qb + 1, group)
+        if balanced
+        else (bh, num_kb, num_qb, group)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel,
-            block_q=bq, block_k=bk, causal=causal, sm_scale=sm_scale, num_qb=num_qb,
+            block_q=bq, block_k=bk, causal=causal, sm_scale=sm_scale,
+            num_qb=num_qb, num_kb=num_kb, group=group, balanced=balanced,
         ),
-        grid=(bh, num_kb, num_qb),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, j, 0)),  # q
-            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),  # k
-            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),  # v
-            pl.BlockSpec((1, bq, d), lambda bhi, i, j: (bhi, j, 0)),  # do
-            pl.BlockSpec((1, bq, 128), lambda bhi, i, j: (bhi, j, 0)),  # lse
-            pl.BlockSpec((1, bq, 128), lambda bhi, i, j: (bhi, j, 0)),  # delta
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda bhi, i, j: (bhi, i, 0)),
-        ],
+        grid=dkv_grid,
+        in_specs=[row_spec, kvc_spec, kvc_spec, row_spec, rstat_spec, rstat_spec],
+        out_specs=[kvc_spec, kvc_spec],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
@@ -445,11 +653,13 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=_compiler_params(pltpu, ("parallel", "parallel", "arbitrary")),
+        compiler_params=_compiler_params(
+            pltpu, ("parallel", "parallel", "arbitrary", "arbitrary")
+        ),
         interpret=interpret,
     )(qt, kt, vt, gt, lse, delta)
 
-    def from_bh(x, s):
-        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    def from_kv(x):
+        return x.reshape(b, hk, sk, d).transpose(0, 2, 1, 3)
 
-    return from_bh(dq, sq), from_bh(dk, sk), from_bh(dv, sk)
+    return _from_grouped(dq, b, h), from_kv(dk), from_kv(dv)

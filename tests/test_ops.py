@@ -33,6 +33,63 @@ def test_flash_kernel_matches_reference(causal):
     assert out.dtype == q.dtype
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_kernel_gqa_native(causal):
+    # kv_heads < heads: the kernel streams un-expanded K/V (no repeat_kv)
+    q, _, _ = qkv(h=8)
+    _, k, v = qkv(h=2)
+    ref = mha_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-2
+
+
+@pytest.mark.parametrize("kv_heads", [4, 1])
+def test_flash_backward_matches_reference_grads(kv_heads):
+    q, _, _ = qkv(s=256, h=4)
+    _, k, v = qkv(s=256, h=kv_heads)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        scale = jnp.maximum(jnp.max(jnp.abs(b)), 1.0)
+        assert jnp.max(jnp.abs(a - b)) / scale < 2e-2, name
+
+
+@pytest.mark.parametrize("kv_heads", [2, 1])
+def test_flash_balanced_causal_grid(kv_heads):
+    """Small blocks force num_qb == num_kb == 4 (even): the work-balanced
+    causal grid (paired q rows, N+1 inner steps) must match the reference,
+    forward and backward."""
+    from odh_kubeflow_tpu.ops.attention import _use_balanced
+
+    assert _use_balanced(True, 128, 128, 4, 4)
+    q, _, _ = qkv(s=512, h=2, d=64)
+    _, k, v = qkv(s=512, h=kv_heads, d=64)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+        )
+        return jnp.sum(out**2), out
+
+    def loss_ref(q, k, v):
+        out = mha_reference(q, k, v, causal=True)
+        return jnp.sum(out**2), out
+
+    (_, out), g_flash = jax.value_and_grad(loss_flash, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    (_, ref), g_ref = jax.value_and_grad(loss_ref, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-2
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        scale = jnp.maximum(jnp.max(jnp.abs(b)), 1.0)
+        assert jnp.max(jnp.abs(a - b)) / scale < 2e-2, name
+
+
 def test_flash_falls_back_off_tpu():
     q, k, v = qkv(s=100)  # not block-divisible -> reference path
     out = flash_attention(q, k, v, causal=True)
@@ -142,7 +199,9 @@ def test_flash_forward_lse_layout_interpret():
     out, lse = _flash_forward_kernel(
         q, k, v, causal=True, block_q=128, block_k=128, interpret=True, with_lse=True
     )
-    assert lse.shape == (b * h, s, 128)
+    # grouped layout: (batch*kv_heads, group, seq, 128); MHA -> group == 1
+    assert lse.shape == (b * h, 1, s, 128)
+    lse = lse[:, 0]
     # lane-broadcast: all 128 lanes carry the same value
     assert jnp.allclose(lse[..., 0], lse[..., 64])
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
