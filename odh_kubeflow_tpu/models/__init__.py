@@ -16,6 +16,7 @@ from .transformer import (
     make_train_step,
     param_specs,
     pp_forward,
+    pp_loss_fn,
     pp_param_specs,
     to_pp_params,
 )
@@ -38,6 +39,7 @@ __all__ = [
     "make_train_step",
     "param_specs",
     "pp_forward",
+    "pp_loss_fn",
     "pp_param_specs",
     "to_pp_params",
 ]

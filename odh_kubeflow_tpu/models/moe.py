@@ -112,18 +112,72 @@ def route_topk(
     return dispatch, combine, aux
 
 
+def _moe_ffn_manual(
+    x: jnp.ndarray, params: Dict[str, Any], cfg: MoEConfig, ep_axis: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """moe_ffn for MANUAL collectives (inside shard_map — the pipeline's
+    stages): expert-stacked params carry only this rank's LOCAL expert shard
+    while the router (tiny, replicated) sees all experts. Tokens are
+    replicated over ep there, so the dispatch all-to-all degenerates: each
+    rank computes its local experts' contributions and one psum over ep
+    completes the combine. The aux loss comes from the full router logits,
+    identical on every ep rank."""
+    b, s, d = x.shape
+    n = b * s
+    e = params["router"].shape[1]  # FULL expert count (static)
+    e_local = params["we_gate"].shape[0]
+    rank = lax.axis_index(ep_axis)
+    capacity = max(1, int(cfg.capacity_factor * n * cfg.experts_per_token / e))
+
+    flat = x.reshape(n, d)
+    logits = flat.astype(jnp.float32) @ params["router"]
+    dispatch, combine, aux = route_topk(logits, cfg.experts_per_token, capacity)
+    disp = lax.dynamic_slice_in_dim(dispatch, rank * e_local, e_local, axis=1)
+    comb = lax.dynamic_slice_in_dim(combine, rank * e_local, e_local, axis=1)
+
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", disp.astype(x.dtype), flat,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    gate = jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["we_gate"],
+        preferred_element_type=jnp.float32,
+    )
+    up = jnp.einsum(
+        "ecd,edf->ecf", expert_in, params["we_up"],
+        preferred_element_type=jnp.float32,
+    )
+    hidden = (jax.nn.silu(gate) * up).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", hidden, params["we_out"],
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    out = jnp.einsum(
+        "nec,ecd->nd", comb.astype(x.dtype), expert_out,
+        preferred_element_type=jnp.float32,
+    )
+    out = lax.psum(out, ep_axis).astype(x.dtype)
+    return out.reshape(b, s, d), aux.astype(jnp.float32)
+
+
 def moe_ffn(
     x: jnp.ndarray,
     params: Dict[str, Any],
     cfg: MoEConfig,
     mesh=None,
+    ep_axis: str = "",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(batch, seq, d) -> (batch, seq, d), plus the router aux loss.
 
     The three einsums below are where expert parallelism happens: with
     `expert_in`/`hidden` sharded ("expert", ...) over ep and x sharded over
-    batch, XLA turns dispatch/combine into all-to-alls over ep."""
+    batch, XLA turns dispatch/combine into all-to-alls over ep. With
+    `ep_axis` set (manual-collective contexts, e.g. pipeline stages under
+    shard_map) the _moe_ffn_manual path runs instead."""
     from ..parallel.mesh import logical_to_spec
+
+    if ep_axis:
+        return _moe_ffn_manual(x, params, cfg, ep_axis)
 
     b, s, d = x.shape
     n = b * s

@@ -231,9 +231,12 @@ def repeat_kv(k, v, cfg: TransformerConfig):
     return jnp.repeat(k, groups, axis=2), jnp.repeat(v, groups, axis=2)
 
 
-def layer_post_attention(x, attn, layer_params, cfg: TransformerConfig, mesh=None):
+def layer_post_attention(
+    x, attn, layer_params, cfg: TransformerConfig, mesh=None, ep_axis: str = ""
+):
     """Attention output projection + MLP half (dense SwiGLU or MoE), shared
-    with the decode path. Returns (x, aux)."""
+    with the decode path. Returns (x, aux). `ep_axis` switches MoE to manual
+    expert collectives (pipeline stages run under shard_map)."""
     constrain = _constrainer(cfg, mesh)
     x = x + jnp.einsum(
         "bsnh,nhd->bsd", attn, layer_params["wo"], preferred_element_type=jnp.float32
@@ -244,7 +247,7 @@ def layer_post_attention(x, attn, layer_params, cfg: TransformerConfig, mesh=Non
     y = rms_norm(x, layer_params["mlp_norm"])
     if cfg.moe is not None:
         moe_params = {k: layer_params[k] for k in MOE_AXES}
-        mlp_out, aux = moe_ffn(y, moe_params, cfg.moe_resolved, mesh)
+        mlp_out, aux = moe_ffn(y, moe_params, cfg.moe_resolved, mesh, ep_axis=ep_axis)
         return x + mlp_out, aux
     gate = jnp.einsum(
         "bsd,df->bsf", y, layer_params["wi_gate"], preferred_element_type=jnp.float32
@@ -260,13 +263,14 @@ def layer_post_attention(x, attn, layer_params, cfg: TransformerConfig, mesh=Non
     return x, jnp.float32(0.0)
 
 
-def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None):
+def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None,
+           ep_axis: str = ""):
     """One pre-norm block. x: (batch, seq, d_model)."""
     constrain = _constrainer(cfg, mesh)
     q, k, v = layer_qkv(x, layer_params, positions, cfg)
     attn = _attention(q, k, v, cfg, mesh)
     attn = constrain(attn, ("batch", "seq", "heads", "head_dim"))
-    return layer_post_attention(x, attn, layer_params, cfg, mesh)
+    return layer_post_attention(x, attn, layer_params, cfg, mesh, ep_axis=ep_axis)
 
 
 def forward(
@@ -330,18 +334,27 @@ def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
     return loss
 
 
-def pp_forward(params, tokens, cfg: TransformerConfig, mesh, n_micro: int = 4):
+def pp_forward(
+    params, tokens, cfg: TransformerConfig, mesh, n_micro: int = 4, with_aux=False
+):
     """Pipeline-parallel forward. `params["layers"]` must be STAGE-STACKED:
     (S, L/S, ...) leaves, S == mesh["pp"], sharded over pp (see
     `to_pp_params`) — the storage layout, so optimizer state shards the same
     way. Microbatches stream through the stages (parallel/pipeline.py);
     embedding and unembed run replicated over pp outside the pipeline.
 
-    Dense configs only for now — MoE aux losses don't thread through the
-    stage carry."""
-    if cfg.moe is not None:
-        raise NotImplementedError("pp_forward does not support MoE configs yet")
+    MoE composes: expert weights stay ep-sharded inside the stages
+    (pp_param_specs), each stage runs manual expert collectives
+    (_moe_ffn_manual), and per-microbatch router aux losses thread through
+    the pipeline with the fill/drain bubbles masked out. with_aux=True
+    returns (logits, aux) where aux is averaged over microbatches —
+    comparable to forward()'s full-batch aux."""
     from ..parallel.pipeline import pipeline_apply
+
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    # manual ep collectives only exist inside the pipeline's shard_map; at
+    # pp=1 pipeline_apply runs the stage inline and GSPMD handles ep
+    ep_axis = "ep" if (cfg.moe is not None and sizes.get("pp", 1) > 1) else ""
 
     # (1, seq): broadcasts against any microbatch size inside the stages
     positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
@@ -351,26 +364,38 @@ def pp_forward(params, tokens, cfg: TransformerConfig, mesh, n_micro: int = 4):
 
     def stage_fn(stage_layers, h):
         def scan_fn(carry, layer_params):
-            new_h, _ = _layer(carry, layer_params, positions, cfg, mesh=None)
-            return new_h, None
+            return _layer(carry, layer_params, positions, cfg, mesh=None,
+                          ep_axis=ep_axis)
 
-        h, _ = lax.scan(scan_fn, h, stage_layers)
-        return h
+        h, auxes = lax.scan(scan_fn, h, stage_layers)
+        return h, jnp.sum(auxes)
 
-    x = pipeline_apply(stage_fn, params["layers"], x, mesh, n_micro=n_micro)
+    param_specs_ = pp_param_specs(cfg, mesh, sizes.get("pp", 1))["layers"]
+    x, aux = pipeline_apply(
+        stage_fn, params["layers"], x, mesh, n_micro=n_micro,
+        with_aux=True, param_specs=param_specs_,
+    )
     x = rms_norm(x, params["final_norm"])
-    return jnp.einsum(
+    logits = jnp.einsum(
         "bsd,dv->bsv", x, params["unembed"], preferred_element_type=jnp.float32
     )
+    if with_aux:
+        return logits, aux / n_micro
+    return logits
 
 
 def pp_loss_fn(params, batch, cfg: TransformerConfig, mesh, n_micro: int = 4):
     tokens = batch["tokens"]
-    logits = pp_forward(params, tokens, cfg, mesh, n_micro=n_micro)
+    logits, aux = pp_forward(
+        params, tokens, cfg, mesh, n_micro=n_micro, with_aux=True
+    )
     logits, targets = logits[:, :-1], tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if cfg.moe is not None:
+        loss = loss + cfg.moe.router_aux_weight * aux / cfg.n_layers
+    return loss
 
 
 def make_pp_train_step(cfg: TransformerConfig, mesh, n_micro: int = 4, optimizer=None):
@@ -409,16 +434,21 @@ def pp_param_specs(cfg: TransformerConfig, mesh, n_stages: int):
     base = param_specs(cfg, mesh)
     from jax.sharding import PartitionSpec
 
-    def add_stage(spec):
-        # stage dim over pp ONLY: pipeline_apply's shard_map runs each stage
-        # with locally-replicated weights, so storing them tp/fsdp-sharded
-        # would force a full all-gather every step (specs must match flow)
+    def add_stage(name, spec):
+        # stage dim over pp; dense weights otherwise locally replicated
+        # (pipeline_apply's shard_map runs each stage with local weights, so
+        # storing them tp/fsdp-sharded would force a full all-gather every
+        # step). Expert-stacked MoE weights KEEP their ep sharding — the
+        # stage's manual-collective MoE consumes exactly the local expert
+        # shard ((S, L/S, E/ep, ...), _moe_ffn_manual).
         del spec
+        if cfg.moe is not None and name in ("we_gate", "we_up", "we_out"):
+            return PartitionSpec("pp", None, "ep")
         return PartitionSpec("pp")
 
     return {
         **{k: v for k, v in base.items() if k != "layers"},
-        "layers": {k: add_stage(v) for k, v in base["layers"].items()},
+        "layers": {k: add_stage(k, v) for k, v in base["layers"].items()},
     }
 
 

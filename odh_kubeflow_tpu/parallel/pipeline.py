@@ -26,22 +26,30 @@ from jax.sharding import PartitionSpec as P
 
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_fn: Callable[[Any, jnp.ndarray], Any],
     stage_params: Any,
     x: jnp.ndarray,
     mesh,
     n_micro: int,
     axis: str = "pp",
+    with_aux: bool = False,
+    param_specs: Any = None,
 ):
     """Run stage-stacked parameters as a microbatched pipeline.
 
-    stage_fn(params_one_stage, x_micro) -> y_micro (same shape as x_micro);
+    stage_fn(params_one_stage, x_micro) -> y_micro (same shape as x_micro),
+    or (y_micro, aux_scalar) when with_aux=True;
     stage_params: pytree whose leaves all have leading dim S (the stage
     count == mesh axis size), sharded over `axis`;
     x: (batch, ...) activations, replicated over `axis` (its batch may be
-    sharded over dp/fsdp as usual).
+    sharded over dp/fsdp as usual);
+    param_specs: optional PartitionSpec pytree for stage_params leaves whose
+    sharding goes beyond P(axis) — e.g. MoE expert weights keeping their ep
+    shard inside the stage (manual-collective MoE).
 
-    Returns the last stage's outputs, replicated over `axis`.
+    Returns the last stage's outputs, replicated over `axis` (plus, with
+    with_aux, the aux scalars summed over stages and real microbatches —
+    fill/drain bubble compute is masked out).
     """
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_stages = sizes[axis]
@@ -64,10 +72,17 @@ def pipeline_apply(
         carry = jnp.zeros_like(micros[0])
         ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         steps = n_micro + n_stages - 1
+        aux_total = jnp.float32(0.0)
         for t in range(steps):  # static unroll: schedule is compile-time
             feed = micros[min(t, n_micro - 1)]
             inp = jnp.where(rank == 0, feed, carry)
             out = stage_fn(params_local, inp)
+            if with_aux:
+                out, aux_t = out
+                # stage r holds real microbatch t-r only inside its window;
+                # fill/drain steps compute on garbage and must not count
+                valid = jnp.logical_and(t >= rank, t - rank < n_micro)
+                aux_total = aux_total + jnp.where(valid, aux_t, 0.0)
             record_idx = max(0, t - (n_stages - 1))
             record = jnp.logical_and(rank == n_stages - 1, t >= n_stages - 1)
             outputs = outputs.at[record_idx].set(
@@ -77,14 +92,22 @@ def pipeline_apply(
         y = outputs.reshape(batch, *x_local.shape[1:])
         # only the last stage holds real outputs; psum of the masked value
         # broadcasts them to every pp rank (grad of psum re-broadcasts)
-        return lax.psum(jnp.where(rank == n_stages - 1, y, jnp.zeros_like(y)), axis)
+        y = lax.psum(jnp.where(rank == n_stages - 1, y, jnp.zeros_like(y)), axis)
+        if not with_aux:
+            return y
+        aux_total = lax.psum(aux_total, axis)  # sum stage contributions
+        for a in data_axes:  # identical scalar on every rank (out_spec P())
+            aux_total = lax.pmean(aux_total, a)
+        return y, aux_total
 
     x_spec = P(data_axes if data_axes else None)
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     return jax.shard_map(
         per_stage,
         mesh=mesh,
-        in_specs=(P(axis), x_spec),
-        out_specs=x_spec,
+        in_specs=(param_specs, x_spec),
+        out_specs=(x_spec, P()) if with_aux else x_spec,
         check_vma=False,
     )(stage_params, x)
 
