@@ -117,7 +117,9 @@ def run_sim(args) -> None:
     teardown = []
     if args.remote:
         try:
-            store, client = _remote_stack(cluster, Config(), teardown)
+            store, client = _remote_stack(
+                cluster, Config(), teardown, qps=args.qps, burst=args.burst
+            )
         except Exception:
             # partial stacks must still tear down (a started TLS server
             # would otherwise outlive the failure)
@@ -180,19 +182,34 @@ def run_sim(args) -> None:
         ),
         "ready_max_s": round(vals[-1], 4) if vals else None,
     }
+    if args.remote and getattr(store, "throttle", None) is not None:
+        # client-side QPS/burst limiter (cluster/remote.py _TokenBucket):
+        # how often the storm actually hit the rate limit
+        result["client_throttle"] = {
+            "qps": store.throttle.qps,
+            "burst": int(store.throttle.burst),
+            "throttled_requests": store.throttle.waits,
+            "throttle_wait_s": round(store.throttle.waited_s, 3),
+        }
     print(json.dumps(result))
     if result["timed_out"]:
         raise SystemExit(1)
 
 
-def _remote_stack(cluster, config, teardown):
+def _remote_stack(cluster, config, teardown, qps=100.0, burst=200):
     """The shared wire-protocol stack (cluster/remote_fixture.py): TLS
     apiserver + HTTPS admission webhook around the sim's store."""
-    from odh_kubeflow_tpu.cluster import Client
+    from odh_kubeflow_tpu.cluster import Client, RemoteStore
     from odh_kubeflow_tpu.cluster.remote_fixture import build_remote_stack
 
-    _, store, _ = build_remote_stack(cluster.store, config, teardown, token="loadtest")
-    return store, Client(store)
+    api, store, _ = build_remote_stack(
+        cluster.store, config, teardown, token="loadtest", qps=qps, burst=burst
+    )
+    # the load GENERATOR polls readiness in a tight loop; give it its own
+    # unthrottled client so the driver's polling doesn't eat the manager's
+    # QPS budget (two clients = two rate limiters, as in a real cluster)
+    poller = RemoteStore(api.base_url, token="loadtest", ca_file=store.ca_file, qps=0)
+    return store, Client(poller)
 
 
 def main() -> None:
@@ -211,6 +228,14 @@ def main() -> None:
         action="store_true",
         help="run the manager over the wire-protocol apiserver (TLS + webhook)",
     )
+    # reference notebook-controller/main.go:65-85 --qps/--burst analog.
+    # Defaults are a production-scale setting (client-go's 20/30 measurably
+    # serializes the readiness-probe polling at storm scale — the stats block
+    # in the output shows how often the limiter engaged either way)
+    ap.add_argument("--qps", type=float, default=100.0,
+                    help="manager client QPS limit (0 = unthrottled)")
+    ap.add_argument("--burst", type=int, default=200,
+                    help="manager client burst size")
     args = ap.parse_args()
     if args.accelerator in ("", "none", "cpu"):
         args.accelerator = ""

@@ -116,7 +116,11 @@ class ApiServer:
         certfile: Optional[str] = None,
         keyfile: Optional[str] = None,
         admission: Optional[AdmissionCallout] = None,
+        heartbeat_polls: int = 30,
     ):
+        # idle 0.5s polls before a watch heartbeat/BOOKMARK (30 -> ~15s,
+        # roughly kube-apiserver's bookmark cadence; tests dial it down)
+        self.heartbeat_polls = heartbeat_polls
         self.store = store
         self.scheme = scheme
         self.mapper = RESTMapper()
@@ -350,9 +354,12 @@ class ApiServer:
                 route.api_version, route.kind, route.namespace, route.name
             )
             patched = json_patch_apply(current, patch)
-            patched.setdefault("metadata", {})["resourceVersion"] = current["metadata"][
-                "resourceVersion"
-            ]
+            # only DEFAULT the RV: a patch that explicitly set one is
+            # expressing optimistic concurrency and store.update_raw must see
+            # (and 409 on) a mismatch, like the real apiserver
+            patched.setdefault("metadata", {}).setdefault(
+                "resourceVersion", current["metadata"]["resourceVersion"]
+            )
             if route.subresource != "status":
                 patched = self._admit("UPDATE", patched, current)
             out = self.store.update_raw(patched, subresource=route.subresource)
@@ -374,9 +381,12 @@ class ApiServer:
                 )
                 patched = json_merge_patch(current, patch)
                 patched = self._admit("UPDATE", patched, current)
-                patched.setdefault("metadata", {})["resourceVersion"] = current[
-                    "metadata"
-                ]["resourceVersion"]
+                # default-only, as in the json-patch branch: a patch-set RV
+                # expresses optimistic concurrency and must reach the
+                # store's conflict check intact
+                patched.setdefault("metadata", {}).setdefault(
+                    "resourceVersion", current["metadata"]["resourceVersion"]
+                )
                 out = self.store.update_raw(patched, subresource=route.subresource)
             else:
                 out = self.store.patch_raw(
@@ -399,6 +409,7 @@ class ApiServer:
 
     def _watch(self, h, route: _Route, query: Dict[str, str]) -> None:
         since_rv = query.get("resourceVersion") or None
+        bookmarks = query.get("allowWatchBookmarks") in ("true", "1")
         selector = parse_label_selector(query.get("labelSelector", ""))
         w = self.store.watch(
             route.api_version,
@@ -426,14 +437,36 @@ class ApiServer:
                     if self._stopping.is_set() or w.stopped:
                         break  # server shutdown or stream severed: end cleanly
                     idle_polls += 1
-                    if idle_polls >= 30:
-                        # heartbeat (BOOKMARK analog): a quiet kind would
-                        # otherwise never touch the socket, so a client gone
-                        # away would leak this handler thread + store watch
-                        send_chunk(b"\n")
+                    if idle_polls >= self.heartbeat_polls:
+                        # heartbeat: a quiet kind would otherwise never touch
+                        # the socket, so a client gone away would leak this
+                        # handler thread + store watch. With
+                        # allowWatchBookmarks requested, ask the STORE to
+                        # enqueue a BOOKMARK through this watch's queue —
+                        # RV read and enqueue are atomic with event emission,
+                        # so a bookmark can never claim progress past an
+                        # event still queued behind it (reading current_rv
+                        # here instead would race exactly that way)
+                        if bookmarks and hasattr(w, "request_bookmark"):
+                            w.request_bookmark()
+                        else:
+                            send_chunk(b"\n")
                         idle_polls = 0
                     continue
                 idle_polls = 0
+                if ev.type == "BOOKMARK":
+                    if not bookmarks:
+                        continue
+                    bm = {
+                        "type": "BOOKMARK",
+                        "object": {
+                            "kind": route.kind,
+                            "apiVersion": route.api_version,
+                            "metadata": ev.object.get("metadata", {}),
+                        },
+                    }
+                    send_chunk((json.dumps(bm) + "\n").encode())
+                    continue
                 if selector is not None and not match_labels(
                     selector, ev.object.get("metadata", {}).get("labels")
                 ):

@@ -88,6 +88,43 @@ def _error_from_response(code: int, raw: bytes) -> ApiError:
     return cls(message or f"HTTP {code}")
 
 
+class _TokenBucket:
+    """Client-side API throttling — the client-go rate.Limiter the reference
+    wires through --kube-api-qps/--kube-api-burst
+    (notebook-controller/main.go:65-72,79-85). Without it a hot reconcile
+    loop hammers a production apiserver unthrottled. Standard token bucket:
+    `burst` tokens refill at `qps`/s; acquire() blocks until one is free."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = float(qps)
+        self.burst = float(burst)
+        self._tokens = float(burst)
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+        self.waits = 0  # observability: REQUESTS that had to sleep (each
+        self.waited_s = 0.0  # counted once, however many retry loops it took)
+
+    def acquire(self) -> None:
+        t_start = None
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._stamp) * self.qps
+                )
+                self._stamp = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    if t_start is not None:
+                        self.waits += 1
+                        self.waited_s += now - t_start
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+                if t_start is None:
+                    t_start = now
+            time.sleep(wait)
+
+
 def _abort_stream(resp) -> None:
     """Abort an in-flight chunked response.
 
@@ -198,8 +235,16 @@ class RemoteWatch:
             backoff = min(backoff * 2, 2.0)
 
     def _stream_once(self) -> None:
+        if not self._rv:
+            # no RV to resume from (initial LIST returned no
+            # listMeta.resourceVersion): streaming without one would make the
+            # server replay full initial ADDEDs, duplicating the snapshot
+            # already delivered — relist to establish an RV first
+            self._relist()
         path = self._store._collection_path(self._api_version, self._kind, self._namespace)
-        url = f"{path}?watch=true&resourceVersion={self._rv}"
+        url = f"{path}?watch=true&allowWatchBookmarks=true"
+        if self._rv:
+            url += f"&resourceVersion={self._rv}"
         resp = self._store._open(url, timeout=self._store.watch_timeout)
         with self._resp_lock:
             if self._stopped.is_set():
@@ -218,6 +263,16 @@ class RemoteWatch:
                     code = ev.get("object", {}).get("code")
                     if code == 410:
                         raise GoneError("watch window expired mid-stream")
+                    continue
+                if ev.get("type") == "BOOKMARK":
+                    # progress marker only: advance the resume RV (so quiet /
+                    # selector-filtered watches don't resume from an expired
+                    # window) but surface no event
+                    rv = ev.get("object", {}).get("metadata", {}).get(
+                        "resourceVersion"
+                    )
+                    if rv:
+                        self._rv = rv
                     continue
                 obj = ev["object"]
                 rv = obj.get("metadata", {}).get("resourceVersion")
@@ -275,11 +330,18 @@ class RemoteStore:
         scheme: Scheme = default_scheme,
         timeout: float = 30.0,
         watch_timeout: float = 300.0,
+        qps: float = 20.0,
+        burst: int = 30,
     ):
         self.base_url = base_url.rstrip("/")
         self.token = token
+        self.ca_file = ca_file
         self.scheme = scheme
         self.timeout = timeout
+        # client-go's default rate limits (QPS 20 / Burst 30); the reference
+        # exposes them as flags and overrides the rest config the same way
+        self.throttle = _TokenBucket(qps, burst) if qps > 0 else None
+        self._owned_tmpfiles: List[str] = []
         # read timeout on watch streams: a partition that dies without a FIN
         # must not hang the reflector forever — on expiry the stream is torn
         # down and resumed from the last seen RV (client-go restarts watches
@@ -305,7 +367,11 @@ class RemoteStore:
 
     @classmethod
     def in_cluster(
-        cls, scheme: Scheme = default_scheme, sa_dir: Optional[str] = None
+        cls,
+        scheme: Scheme = default_scheme,
+        sa_dir: Optional[str] = None,
+        qps: float = 20.0,
+        burst: int = 30,
     ) -> "RemoteStore":
         """Bootstrap from the pod environment: apiserver address from
         KUBERNETES_SERVICE_HOST/PORT, bearer token + CA from the
@@ -334,6 +400,8 @@ class RemoteStore:
             token=token,
             ca_file=ca_path,
             scheme=scheme,
+            qps=qps,
+            burst=burst,
         )
         # bound SA tokens rotate (~1h); re-read the projection per request
         # like client-go, or every call 401s after the first expiry
@@ -348,6 +416,8 @@ class RemoteStore:
         path: Optional[str] = None,
         context: Optional[str] = None,
         scheme: Scheme = default_scheme,
+        qps: float = 20.0,
+        burst: int = 30,
     ) -> "RemoteStore":
         import yaml
 
@@ -369,6 +439,8 @@ class RemoteStore:
             {},
         )
 
+        owned: List[str] = []
+
         def materialize(inline_key: str, file_key: str, source: Dict[str, Any]) -> Optional[str]:
             if source.get(file_key):
                 return source[file_key]
@@ -378,19 +450,40 @@ class RemoteStore:
             f = tempfile.NamedTemporaryFile("wb", delete=False, suffix=".pem")
             f.write(base64.b64decode(data))
             f.close()
+            owned.append(f.name)
             return f.name
 
         ca = materialize("certificate-authority-data", "certificate-authority", cluster)
         cert = materialize("client-certificate-data", "client-certificate", user)
         key = materialize("client-key-data", "client-key", user)
-        return cls(
+        store = cls(
             base_url=cluster["server"],
             token=user.get("token"),
             ca_file=ca,
             client_cert=(cert, key) if cert and key else None,
             insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
             scheme=scheme,
+            qps=qps,
+            burst=burst,
         )
+        # inline CA/cert/key were materialized to disk for the ssl API; they
+        # hold private key material and must not outlive the store (atexit as
+        # a backstop — close() may never be called on crash paths)
+        store._owned_tmpfiles = owned
+        if owned:
+            import atexit
+
+            atexit.register(store.close)
+        return store
+
+    def close(self) -> None:
+        """Remove any key material this store materialized to disk."""
+        for path in self._owned_tmpfiles:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._owned_tmpfiles = []
 
     # -- HTTP plumbing --
 
@@ -415,6 +508,8 @@ class RemoteStore:
 
     def _open(self, path: str, method: str = "GET", body: Optional[bytes] = None,
               content_type: Optional[str] = None, timeout: Optional[float] = None):
+        if self.throttle is not None:
+            self.throttle.acquire()
         req = urllib.request.Request(
             self.base_url + path,
             data=body,

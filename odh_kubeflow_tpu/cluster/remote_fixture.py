@@ -23,8 +23,13 @@ def build_remote_stack(
     config,
     teardown: List[Callable[[], None]],
     token: str = "wire-token",
+    qps: float = 0.0,
+    burst: int = 0,
 ) -> Tuple[Any, Any, Any]:
-    """Returns (api_server, remote_store, webhook_server)."""
+    """Returns (api_server, remote_store, webhook_server). qps=0 (default)
+    leaves the client unthrottled — timing-sensitive e2e suites must not
+    absorb rate-limiter sleeps they never asked for; the loadtest opts in
+    explicitly."""
     from ..api.admission import (
         MutatingWebhook,
         MutatingWebhookConfiguration,
@@ -53,7 +58,9 @@ def build_remote_stack(
         admission=WebhookDispatcher(store),
     ).start()
     teardown.append(api.stop)
-    remote = RemoteStore(api.base_url, token=token, ca_file=ca, timeout=30)
+    remote = RemoteStore(
+        api.base_url, token=token, ca_file=ca, timeout=30, qps=qps, burst=burst
+    )
 
     webhook_server = WebhookServer(certfile=crt, keyfile=key).start()
     teardown.append(webhook_server.stop)

@@ -45,6 +45,7 @@ from ..apimachinery import (
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"  # progress marker: current RV, no object payload
 
 # kinds whose GVK groups several served versions onto one storage key
 _STORAGE_KEY_OVERRIDES: Dict[Tuple[str, str], Tuple[str, str]] = {}
@@ -93,15 +94,28 @@ class Watch:
         q: "queue.Queue[Optional[WatchEvent]]",
         cancel: Callable[[], None],
         namespace: Optional[str] = None,
+        bookmark: Optional[Callable[[], None]] = None,
     ):
         self._q = q
         self._cancel = cancel
         self._namespace = namespace
+        self._bookmark = bookmark
         self.stopped = False
         self.pending: List[WatchEvent] = []  # initial-list synthetic ADDEDs
 
+    def request_bookmark(self) -> None:
+        """Enqueue a BOOKMARK event carrying the store's current RV, ORDERED
+        with the event stream: the RV is read and the event queued under the
+        store lock, so a bookmark can never claim progress past an event that
+        has not yet been queued to this watch (reading current_rv out-of-band
+        races exactly that way)."""
+        if self._bookmark is not None:
+            self._bookmark()
+
     def _admit(self, ev: Optional[WatchEvent]) -> bool:
         if ev is None or self._namespace is None:
+            return True
+        if ev.type == BOOKMARK:  # progress markers are namespace-less
             return True
         return ev.object.get("metadata", {}).get("namespace", "") == self._namespace
 
@@ -624,6 +638,17 @@ class Store:
                     except ValueError:
                         pass
 
-            w = Watch(q, cancel, namespace=namespace)
+            def bookmark() -> None:
+                # under the store lock: RV read + enqueue are atomic w.r.t.
+                # every _emit, so queue order == RV order
+                with self._lock:
+                    q.put(
+                        WatchEvent(
+                            BOOKMARK,
+                            {"metadata": {"resourceVersion": self.current_rv()}},
+                        )
+                    )
+
+            w = Watch(q, cancel, namespace=namespace, bookmark=bookmark)
             w.pending = pending
         return w

@@ -96,13 +96,33 @@ def main() -> None:  # pragma: no cover - thin CLI shell
     if os.environ.get("KUBERNETES_SERVICE_HOST") or os.environ.get("KUBECONFIG"):
         from .cluster.remote import RemoteStore
 
+        # --qps/--burst analog (reference notebook-controller/main.go:65-85
+        # overrides the rest config the same way): 0/unset keeps the client
+        # defaults (20/30), negative means unlimited (rest.Config's -1
+        # convention), junk falls back to the default rather than crashing
+        # the manager at boot
+        def _env_num(name, default, cast):
+            try:
+                val = cast(os.environ.get(name, "") or default)
+            except ValueError:
+                logging.getLogger(__name__).warning(
+                    "ignoring non-numeric %s=%r", name, os.environ.get(name)
+                )
+                return default
+            return val if val else default
+
+        qps = _env_num("KUBE_API_QPS", 20.0, float)
+        burst = _env_num("KUBE_API_BURST", 30, int)
+        if qps < 0:
+            qps = 0.0  # RemoteStore treats qps<=0 as unthrottled
+
         # KUBECONFIG first (GetConfig precedence): an explicit override must
         # win over the auto-injected pod env, or a manager run inside ANY pod
         # would silently target the host cluster
         if os.environ.get("KUBECONFIG"):
-            store = RemoteStore.from_kubeconfig()
+            store = RemoteStore.from_kubeconfig(qps=qps, burst=burst)
         else:
-            store = RemoteStore.in_cluster()
+            store = RemoteStore.in_cluster(qps=qps, burst=burst)
         cert_dir = os.environ.get("WEBHOOK_CERT_DIR", "/tmp/k8s-webhook-server/serving-certs")
         if os.path.exists(os.path.join(cert_dir, "tls.crt")):
             from .cluster.client import Client

@@ -377,3 +377,28 @@ def test_in_cluster_config(tmp_path, monkeypatch):
             RemoteStore.in_cluster(sa_dir=str(sa_dir))
     finally:
         server.stop()
+
+
+def test_watch_bookmarks_advance_resume_rv():
+    """allowWatchBookmarks end to end: a watch on a kind with NO traffic
+    still advances its resume RV from server BOOKMARK events (other kinds
+    move the global RV), so reconnecting after a long quiet period does not
+    410 even when the history window has rolled past the last seen event."""
+    store = Store(watch_history_limit=4)
+    server = ApiServer(store, heartbeat_polls=1).start()  # bookmark ~0.5s
+    remote = RemoteStore(server.base_url, timeout=5)
+    try:
+        w = remote.watch("v1", "ConfigMap", namespace="quiet")
+        rv0 = w._rv
+        # traffic on a DIFFERENT namespace: the quiet watch sees no events
+        # (namespace-scoped), but bookmarks carry the advancing global RV
+        for i in range(6):
+            store.create_raw(cm(f"noise-{i}", ns="other"))
+        deadline = time.time() + 10
+        while w._rv == rv0 and time.time() < deadline:
+            time.sleep(0.1)
+        assert int(w._rv) > int(rv0 or "0"), "bookmark never advanced the RV"
+        assert w.get(timeout=0.2) is None  # bookmarks surface no events
+        w.stop()
+    finally:
+        server.stop()
