@@ -117,10 +117,15 @@ class ApiServer:
         keyfile: Optional[str] = None,
         admission: Optional[AdmissionCallout] = None,
         heartbeat_polls: int = 30,
+        audit_path: Optional[str] = None,
     ):
         # idle 0.5s polls before a watch heartbeat/BOOKMARK (30 -> ~15s,
         # roughly kube-apiserver's bookmark cadence; tests dial it down)
         self.heartbeat_polls = heartbeat_polls
+        # debug escape (envtest's audit-log dump analog, reference odh
+        # controllers/suite_test.go:125-155): JSON-lines request log
+        self.audit_path = audit_path
+        self._audit_lock = threading.Lock()
         self.store = store
         self.scheme = scheme
         self.mapper = RESTMapper()
@@ -187,7 +192,23 @@ class ApiServer:
 
     # -- request plumbing --
 
+    def _audit(self, method: str, path: str, outcome: str) -> None:
+        if not self.audit_path:
+            return
+        import time as _time
+
+        line = json.dumps(
+            {"ts": _time.time(), "method": method, "path": path, "outcome": outcome}
+        )
+        with self._audit_lock:
+            try:
+                with open(self.audit_path, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass
+
     def _dispatch(self, h: BaseHTTPRequestHandler, method: str) -> None:
+        outcome = "ok"
         try:
             if not self._authorized(h):
                 raise UnauthorizedError("missing or invalid bearer token")
@@ -214,15 +235,19 @@ class ApiServer:
             else:
                 raise InvalidError(f"unsupported {method} on {parsed.path!r}")
         except ApiError as e:
+            outcome = f"{e.code} {e.reason}"
             self._send_status_error(h, e)
         except (BrokenPipeError, ConnectionResetError):
-            pass
+            outcome = "client-gone"
         except Exception as e:  # never leak a stack trace into the connection
+            outcome = f"internal: {e!r}"
             err = ApiError(f"internal error: {e!r}")
             try:
                 self._send_status_error(h, err)
             except OSError:
                 pass
+        finally:
+            self._audit(method, h.path, outcome)
 
     def _authorized(self, h: BaseHTTPRequestHandler) -> bool:
         if self.bearer_token is None:

@@ -88,6 +88,16 @@ def _error_from_response(code: int, raw: bytes) -> ApiError:
     return cls(message or f"HTTP {code}")
 
 
+def _unlink_all(paths: List[str]) -> None:
+    """Drain `paths` IN PLACE, unlinking each — shared between close() and
+    the atexit backstop so whichever runs first empties the same list."""
+    while paths:
+        try:
+            os.unlink(paths.pop())
+        except OSError:
+            pass
+
+
 class _TokenBucket:
     """Client-side API throttling — the client-go rate.Limiter the reference
     wires through --kube-api-qps/--kube-api-burst
@@ -97,7 +107,9 @@ class _TokenBucket:
 
     def __init__(self, qps: float, burst: int):
         self.qps = float(qps)
-        self.burst = float(burst)
+        # burst <= 0 would cap tokens below 1.0 forever and hang every
+        # request; unthrottled is expressed as qps<=0 (no bucket), so clamp
+        self.burst = max(1.0, float(burst))
         self._tokens = float(burst)
         self._stamp = time.monotonic()
         self._lock = threading.Lock()
@@ -456,34 +468,35 @@ class RemoteStore:
         ca = materialize("certificate-authority-data", "certificate-authority", cluster)
         cert = materialize("client-certificate-data", "client-certificate", user)
         key = materialize("client-key-data", "client-key", user)
-        store = cls(
-            base_url=cluster["server"],
-            token=user.get("token"),
-            ca_file=ca,
-            client_cert=(cert, key) if cert and key else None,
-            insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
-            scheme=scheme,
-            qps=qps,
-            burst=burst,
-        )
+        try:
+            store = cls(
+                base_url=cluster["server"],
+                token=user.get("token"),
+                ca_file=ca,
+                client_cert=(cert, key) if cert and key else None,
+                insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
+                scheme=scheme,
+                qps=qps,
+                burst=burst,
+            )
+        except Exception:
+            _unlink_all(owned)  # don't leak key material when construction fails
+            raise
         # inline CA/cert/key were materialized to disk for the ssl API; they
-        # hold private key material and must not outlive the store (atexit as
-        # a backstop — close() may never be called on crash paths)
+        # hold private key material and must not outlive the store. atexit
+        # holds only the PATH LIST (close() drains it in place), not the
+        # store — long-lived processes building stores repeatedly must not
+        # accumulate unreclaimable objects in the atexit registry
         store._owned_tmpfiles = owned
         if owned:
             import atexit
 
-            atexit.register(store.close)
+            atexit.register(_unlink_all, owned)
         return store
 
     def close(self) -> None:
         """Remove any key material this store materialized to disk."""
-        for path in self._owned_tmpfiles:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
-        self._owned_tmpfiles = []
+        _unlink_all(self._owned_tmpfiles)
 
     # -- HTTP plumbing --
 

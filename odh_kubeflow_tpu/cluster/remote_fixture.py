@@ -11,6 +11,7 @@ down when a later step fails.
 from __future__ import annotations
 
 import base64
+import os
 import shutil
 import tempfile
 from typing import Any, Callable, List, Tuple
@@ -50,14 +51,40 @@ def build_remote_stack(
     with open(ca, "rb") as f:
         ca_b64 = base64.b64encode(f.read()).decode()
 
+    # debug escapes (reference envtest fixture's audit-log dump + kubeconfig
+    # export, odh controllers/suite_test.go:125-155): point
+    # ODH_WIRE_DEBUG_DIR at a directory and the fixture writes an apiserver
+    # request audit log plus a kubeconfig any kubectl-shaped client (or a
+    # second RemoteStore) can use to poke the live stack mid-test
+    debug_dir = os.environ.get("ODH_WIRE_DEBUG_DIR", "")
+    audit_path = os.path.join(debug_dir, "apiserver-audit.jsonl") if debug_dir else None
+
     api = ApiServer(
         store,
         bearer_token=token,
         certfile=crt,
         keyfile=key,
         admission=WebhookDispatcher(store),
+        audit_path=audit_path,
     ).start()
     teardown.append(api.stop)
+    if debug_dir:
+        os.makedirs(debug_dir, exist_ok=True)
+        kubeconfig = {
+            "apiVersion": "v1",
+            "kind": "Config",
+            "current-context": "wire-fixture",
+            "contexts": [{"name": "wire-fixture",
+                          "context": {"cluster": "wire-fixture", "user": "fixture"}}],
+            "clusters": [{"name": "wire-fixture",
+                          "cluster": {"server": api.base_url,
+                                      "certificate-authority": ca}}],
+            "users": [{"name": "fixture", "user": {"token": token}}],
+        }
+        import yaml
+
+        with open(os.path.join(debug_dir, "kubeconfig"), "w") as f:
+            yaml.safe_dump(kubeconfig, f)
     remote = RemoteStore(
         api.base_url, token=token, ca_file=ca, timeout=30, qps=qps, burst=burst
     )

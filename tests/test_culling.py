@@ -180,3 +180,53 @@ def test_probe_failure_defers_culling(env):
     assert C.STOP_ANNOTATION not in nb.metadata.annotations
     assert C.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION in nb.metadata.annotations
 
+
+
+def test_dev_mode_probes_through_local_proxy():
+    """DEV mode (reference culling_controller.go:249-273): probes route
+    through a localhost:8001 kubectl-proxy URL instead of the in-cluster
+    service DNS name, so the culler is debuggable off-cluster. The proxy
+    path targets the service's ACTUAL port name (http-notebook,
+    notebook_controller.go:543) — the reference's format string interpolates
+    http-{name} there, which its own service never defines."""
+    from odh_kubeflow_tpu.controllers import Config
+    from odh_kubeflow_tpu.controllers.culling import CullingReconciler
+
+    nb = Notebook()
+    nb.metadata.name = "my-nb"
+    nb.metadata.namespace = "team-a"
+
+    def make(dev: bool) -> str:
+        rec = CullingReconciler.__new__(CullingReconciler)
+        rec.config = Config()
+        rec.config.dev_mode = dev
+        return rec.jupyter_url(nb, "kernels")
+
+    assert make(True) == (
+        "http://localhost:8001/api/v1/namespaces/team-a/services/"
+        "my-nb:http-notebook/proxy/notebook/team-a/my-nb/api/kernels"
+    )
+    # non-DEV: in-cluster service DNS, reference URL shape
+    assert make(False) == (
+        "http://my-nb.team-a.svc.cluster.local/notebook/team-a/my-nb/api/kernels"
+    )
+
+
+def test_dev_mode_env_flag():
+    """DEV env var flips dev_mode exactly like the reference's GetEnvDefault
+    (\"false\" default)."""
+    import os
+
+    from odh_kubeflow_tpu.controllers import Config
+
+    old = os.environ.get("DEV")
+    try:
+        os.environ["DEV"] = "true"
+        assert Config.from_env().dev_mode is True
+        os.environ["DEV"] = "false"
+        assert Config.from_env().dev_mode is False
+    finally:
+        if old is None:
+            os.environ.pop("DEV", None)
+        else:
+            os.environ["DEV"] = old

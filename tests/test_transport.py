@@ -402,3 +402,34 @@ def test_watch_bookmarks_advance_resume_rv():
         w.stop()
     finally:
         server.stop()
+
+
+def test_wire_fixture_debug_escapes(tmp_path, monkeypatch):
+    """ODH_WIRE_DEBUG_DIR (envtest suite_test.go:125-155 analog): the fixture
+    exports a kubeconfig a SECOND client can bootstrap from, and an apiserver
+    audit log records every request with its outcome."""
+    import json as _json
+
+    from odh_kubeflow_tpu.cluster.remote_fixture import build_remote_stack
+    from odh_kubeflow_tpu.controllers import Config
+
+    monkeypatch.setenv("ODH_WIRE_DEBUG_DIR", str(tmp_path))
+    teardown = []
+    try:
+        _, remote, _ = build_remote_stack(Store(), Config(), teardown, token="dbg")
+        remote.create_raw(cm("probe"))
+        # a fresh client built ONLY from the exported kubeconfig
+        second = RemoteStore.from_kubeconfig(path=str(tmp_path / "kubeconfig"))
+        got = second.get_raw("v1", "ConfigMap", "default", "probe")
+        assert got["metadata"]["name"] == "probe"
+        with pytest.raises(NotFoundError):
+            second.get_raw("v1", "ConfigMap", "default", "nope")
+        lines = [
+            _json.loads(line)
+            for line in (tmp_path / "apiserver-audit.jsonl").read_text().splitlines()
+        ]
+        assert any(e["method"] == "POST" and e["outcome"] == "ok" for e in lines)
+        assert any(e["outcome"].startswith("404") for e in lines)
+    finally:
+        for fn in reversed(teardown):
+            fn()
