@@ -236,9 +236,11 @@ def bench_decode():
 
     Completion is a host scalar fetch, NOT block_until_ready — through the
     per-dispatch tunnel block_until_ready can return before the program
-    finishes (observed: absurd token rates). Each timed call also carries a
-    fixed ~100ms tunnel round-trip; the full-generate minus prefill-only
-    subtraction cancels it, so decode_per_token_ms is net device time."""
+    finishes (observed: absurd token rates). Per-token time comes from the
+    two-length slope (max_new 32 vs 128, min-of-reps): prefill time AND the
+    large, variable tunnel round-trip cancel exactly — the old full-minus-
+    prefill subtraction carried the round-trip's ±100 ms jitter, i.e.
+    ±0.8 ms/token of pure noise."""
     import jax
     import jax.numpy as jnp
 
@@ -256,31 +258,29 @@ def bench_decode():
         remat=False,
     )
     batch, prompt_len, max_new = 8, 128, 128
+    short_new = 32
     params = init_params(jax.random.PRNGKey(0), cfg)
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab)
 
     def fetch(x):
         int(jnp.sum(x))  # host fetch = true completion
 
-    def run_full():
-        fetch(generate(params, prompt, cfg, max_new=max_new))
+    def timed(n_new):
+        # fixed max_seq so both lengths share cache shapes
+        def run():
+            t0 = time.perf_counter()
+            fetch(generate(params, prompt, cfg, max_new=n_new,
+                           max_seq=prompt_len + max_new))
+            return time.perf_counter() - t0
 
-    def run_prefill():
-        fetch(generate(params, prompt, cfg, max_new=1, max_seq=prompt_len + max_new))
+        run()  # compile + warm
+        return min(run() for _ in range(4))
 
-    run_full()  # compile + warm
-    run_prefill()
-    fulls, prefills = [], []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run_full()
-        fulls.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        run_prefill()
-        prefills.append(time.perf_counter() - t0)
-    elapsed = statistics.median(fulls)
-    prefill_s = statistics.median(prefills)
-    decode_s = max(elapsed - prefill_s, 1e-9)
+    t_long = timed(max_new)
+    t_short = timed(short_new)
+    decode_s = max(t_long - t_short, 1e-9) * (max_new - 1) / (max_new - short_new)
+    elapsed = t_long  # wall for the full generate (incl. one tunnel trip)
+    prefill_s = max(t_long - decode_s, 0.0)
     # per-step HBM floor: every decode token re-reads all params + the cache.
     # The embed table doesn't stream — decode gathers `batch` rows — so it's
     # excluded (unembed DOES stream through the logits matmul).

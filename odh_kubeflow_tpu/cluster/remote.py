@@ -110,7 +110,8 @@ class _TokenBucket:
         # burst <= 0 would cap tokens below 1.0 forever and hang every
         # request; unthrottled is expressed as qps<=0 (no bucket), so clamp
         self.burst = max(1.0, float(burst))
-        self._tokens = float(burst)
+        self._tokens = self.burst  # the CLAMPED burst: raw burst<=0 here
+        # would start the bucket in debt and stall the first request
         self._stamp = time.monotonic()
         self._lock = threading.Lock()
         self.waits = 0  # observability: REQUESTS that had to sleep (each
