@@ -31,7 +31,9 @@ class SimCluster:
     def __init__(self) -> None:
         self.store = Store()
         self.client = Client(self.store)
-        self.system = Manager(self.store)
+        # system controllers are the CLUSTER side (kube-controller-manager /
+        # kubelet analogs): they read authoritative store state, not a cache
+        self.system = Manager(self.store, cached_reads=False)
         self.scheduler = Scheduler(self.system)
         self.sts_controller = StatefulSetController(self.system)
         self.kubelet = Kubelet(self.system)

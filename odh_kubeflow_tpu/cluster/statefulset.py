@@ -39,6 +39,7 @@ class StatefulSetController:
     def __init__(self, manager: Manager):
         self.manager = manager
         self.client = manager.client
+        self.api_reader = manager.api_reader
 
     def setup(self) -> None:
         (
@@ -98,7 +99,7 @@ class StatefulSetController:
             # read-modify-write conflict-crash here (retry.RetryOnConflict
             # at every multi-writer site — SURVEY §5)
             try:
-                cur = self.client.get(StatefulSet, req.namespace, req.name)
+                cur = self.api_reader.get(StatefulSet, req.namespace, req.name)
             except NotFoundError:
                 return
             if (

@@ -56,6 +56,10 @@ class CullingReconciler:
     ):
         self.manager = manager
         self.client = manager.client
+        # culling is DESTRUCTIVE (replicas -> 0 frees the slice): every read
+        # feeding the idle decision must be fresh, not informer-cache stale —
+        # a lagging cache after un-stop briefly looks idle and would re-cull
+        self.api_reader = manager.api_reader
         self.config = config or Config()
         self.http_get = http_get or _default_http_get
         self.metrics = metrics or NotebookMetrics(manager.metrics)
@@ -95,7 +99,7 @@ class CullingReconciler:
             nb.spec.tpu.accelerator, nb.spec.tpu.topology, nb.spec.tpu.chips
         )
         return per_ordinal_probe_urls(
-            self.client, self.config, nb, shape.hosts, "/tpu/utilization"
+            self.api_reader, self.config, nb, shape.hosts, "/tpu/utilization"
         )
 
     # ---------- probes ----------
@@ -157,7 +161,7 @@ class CullingReconciler:
     def reconcile(self, req: Request) -> Optional[Result]:
         period_s = self.config.idleness_check_period_min * 60.0
         try:
-            nb = self.client.get(Notebook, req.namespace, req.name)
+            nb = self.api_reader.get(Notebook, req.namespace, req.name)
         except NotFoundError:
             return None
         if nb.metadata.deletion_timestamp:
@@ -173,7 +177,7 @@ class CullingReconciler:
 
         # pod 0 gone: nothing to probe (reference :120-135)
         try:
-            self.client.get(
+            self.api_reader.get(
                 Pod, nb.metadata.namespace, f"{statefulset_name(nb.metadata.name)}-0"
             )
         except NotFoundError:

@@ -93,6 +93,7 @@ class TPUWorkbenchReconciler:
     def __init__(self, manager: Manager, config: Optional[Config] = None):
         self.manager = manager
         self.client = manager.client
+        self.api_reader = manager.api_reader
         self.config = config or Config()
 
     def setup(self) -> None:
@@ -174,7 +175,7 @@ class TPUWorkbenchReconciler:
             return
 
         def attempt():
-            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            cur = self.api_reader.get(Notebook, nb.metadata.namespace, nb.metadata.name)
             for f in FINALIZERS:
                 if f not in cur.metadata.finalizers:
                     cur.metadata.finalizers.append(f)
@@ -211,7 +212,7 @@ class TPUWorkbenchReconciler:
             raise RuntimeError("finalization incomplete: " + "; ".join(errors))
 
         def drop():
-            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            cur = self.api_reader.get(Notebook, nb.metadata.namespace, nb.metadata.name)
             cur.metadata.finalizers = [
                 f for f in cur.metadata.finalizers if f not in FINALIZERS
             ]
@@ -546,7 +547,7 @@ class TPUWorkbenchReconciler:
             return
 
         def attempt():
-            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            cur = self.api_reader.get(Notebook, nb.metadata.namespace, nb.metadata.name)
             if cur.metadata.annotations.get(C.STOP_ANNOTATION) != C.RECONCILIATION_LOCK_VALUE:
                 return cur
             return self.client.patch(
@@ -568,24 +569,31 @@ class TPUWorkbenchReconciler:
 
     def _create_or_replace_spec(self, desired, field: str = "spec") -> None:
         cls = type(desired)
-        try:
-            cur = self.client.get(cls, desired.metadata.namespace, desired.metadata.name)
-        except NotFoundError:
-            self._create(desired)
-            return
-        cur_val = getattr(cur, field)
-        des_val = getattr(desired, field)
-        cur_dict = cur_val.to_dict() if hasattr(cur_val, "to_dict") else cur_val
-        des_dict = des_val.to_dict() if hasattr(des_val, "to_dict") else des_val
-        changed = False
-        if cur_dict != des_dict:
-            setattr(cur, field, des_val)
-            changed = True
-        if desired.metadata.labels and cur.metadata.labels != desired.metadata.labels:
-            cur.metadata.labels = desired.metadata.labels
-            changed = True
-        if changed:
-            self.client.update(cur)
+
+        def attempt():
+            try:
+                # fresh read: a cached RV straight after our own write 409s
+                cur = self.api_reader.get(
+                    cls, desired.metadata.namespace, desired.metadata.name
+                )
+            except NotFoundError:
+                self._create(desired)
+                return
+            cur_val = getattr(cur, field)
+            des_val = getattr(desired, field)
+            cur_dict = cur_val.to_dict() if hasattr(cur_val, "to_dict") else cur_val
+            des_dict = des_val.to_dict() if hasattr(des_val, "to_dict") else des_val
+            changed = False
+            if cur_dict != des_dict:
+                setattr(cur, field, des_val)
+                changed = True
+            if desired.metadata.labels and cur.metadata.labels != desired.metadata.labels:
+                cur.metadata.labels = desired.metadata.labels
+                changed = True
+            if changed:
+                self.client.update(cur)
+
+        retry_on_conflict(attempt)
 
 
 def _format_key_name(display_name: str) -> str:

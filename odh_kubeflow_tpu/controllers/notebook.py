@@ -94,6 +94,7 @@ class NotebookReconciler:
                  metrics: Optional[NotebookMetrics] = None):
         self.manager = manager
         self.client = manager.client
+        self.api_reader = manager.api_reader
         self.config = config or Config()
         self.metrics = metrics or NotebookMetrics(manager.metrics, manager.client)
 
@@ -274,63 +275,85 @@ class NotebookReconciler:
 
     def _reconcile_statefulset(self, nb: Notebook, shape: Optional[SliceShape]) -> None:
         desired = self.generate_statefulset(nb, shape)
-        try:
-            current = self.client.get(StatefulSet, nb.metadata.namespace, desired.metadata.name)
-        except NotFoundError:
+
+        def attempt():
             try:
-                self.client.create(desired)
-                self.metrics.notebook_create_total.inc()
-            except Exception:
-                self.metrics.notebook_create_failed_total.inc()
-                raise
-            return
-        # CopyStatefulSetFields semantics (reference common/reconcilehelper/
-        # util.go:107-160): labels/annotations/replicas/template copied over
-        changed = False
-        if current.metadata.labels != desired.metadata.labels:
-            current.metadata.labels = desired.metadata.labels
-            changed = True
-        if current.spec.replicas != desired.spec.replicas:
-            current.spec.replicas = desired.spec.replicas
-            changed = True
-        if current.spec.template.to_dict() != desired.spec.template.to_dict():
-            current.spec.template = desired.spec.template
-            changed = True
-        if changed:
-            self.client.update(current)
+                # FRESH read: the cached view after our own create/update is
+                # stale exactly in the write-to-informer-dispatch window
+                current = self.api_reader.get(
+                    StatefulSet, nb.metadata.namespace, desired.metadata.name
+                )
+            except NotFoundError:
+                try:
+                    self.client.create(desired)
+                    self.metrics.notebook_create_total.inc()
+                except AlreadyExistsError:
+                    return  # a racing reconcile created it: converged
+                except Exception:
+                    self.metrics.notebook_create_failed_total.inc()
+                    raise
+                return
+            # CopyStatefulSetFields semantics (reference common/
+            # reconcilehelper/util.go:107-160): labels/annotations/replicas/
+            # template copied over
+            changed = False
+            if current.metadata.labels != desired.metadata.labels:
+                current.metadata.labels = desired.metadata.labels
+                changed = True
+            if current.spec.replicas != desired.spec.replicas:
+                current.spec.replicas = desired.spec.replicas
+                changed = True
+            if current.spec.template.to_dict() != desired.spec.template.to_dict():
+                current.spec.template = desired.spec.template
+                changed = True
+            if changed:
+                self.client.update(current)
+
+        retry_on_conflict(attempt)
 
     def _reconcile_service(self, nb: Notebook, desired: Service) -> None:
-        try:
-            current = self.client.get(Service, nb.metadata.namespace, desired.metadata.name)
-        except NotFoundError:
-            self.client.create(desired)
-            return
-        # CopyServiceFields: keep clusterIP, copy selector/ports/labels
-        changed = False
-        if current.metadata.labels != desired.metadata.labels:
-            current.metadata.labels = desired.metadata.labels
-            changed = True
-        if current.spec.selector != desired.spec.selector:
-            current.spec.selector = desired.spec.selector
-            changed = True
-        if [p.to_dict() for p in current.spec.ports] != [
-            p.to_dict() for p in desired.spec.ports
-        ]:
-            current.spec.ports = desired.spec.ports
-            changed = True
-        if changed:
-            self.client.update(current)
+        def attempt():
+            try:
+                current = self.api_reader.get(
+                    Service, nb.metadata.namespace, desired.metadata.name
+                )
+            except NotFoundError:
+                try:
+                    self.client.create(desired)
+                except AlreadyExistsError:
+                    pass  # racing reconcile won; level-triggered convergence
+                return
+            # CopyServiceFields: keep clusterIP, copy selector/ports/labels
+            changed = False
+            if current.metadata.labels != desired.metadata.labels:
+                current.metadata.labels = desired.metadata.labels
+                changed = True
+            if current.spec.selector != desired.spec.selector:
+                current.spec.selector = desired.spec.selector
+                changed = True
+            if [p.to_dict() for p in current.spec.ports] != [
+                p.to_dict() for p in desired.spec.ports
+            ]:
+                current.spec.ports = desired.spec.ports
+                changed = True
+            if changed:
+                self.client.update(current)
+
+        retry_on_conflict(attempt)
 
     def _update_status(self, nb: Notebook, shape: Optional[SliceShape]) -> None:
+        # FRESH reads for published status: hosts_ready pairs with the probe
+        # controller's LIVE mesh_ready — counting pods from a lagging cache
+        # can publish mesh_ready=True alongside a stale hosts_ready
         try:
-            sts = self.client.get(
+            sts = self.api_reader.get(
                 StatefulSet, nb.metadata.namespace, statefulset_name(nb.metadata.name)
             )
         except NotFoundError:
             return
         pods = [
             p
-            for p in self.client.list(
+            for p in self.api_reader.list(
                 Pod,
                 namespace=nb.metadata.namespace,
                 labels={C.NOTEBOOK_NAME_LABEL: nb.metadata.name},
@@ -392,7 +415,7 @@ class NotebookReconciler:
             # chips keeps mesh_ready false even with every pod Ready
 
         def write():
-            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            cur = self.api_reader.get(Notebook, nb.metadata.namespace, nb.metadata.name)
             if shape is not None and cur.status.tpu is not None:
                 # preserve the probe controller's fields (two status writers,
                 # disjoint field ownership)

@@ -52,6 +52,9 @@ class ProbeStatusController:
     ):
         self.manager = manager
         self.client = manager.client
+        # fresh reads for read-modify-write (manager.client may serve a
+        # just-stale informer cache)
+        self.api_reader = manager.api_reader
         self.config = config or Config()
         self.http_get = http_get or _default_http_get
         self.metrics = metrics or NotebookMetrics(manager.metrics)
@@ -171,7 +174,7 @@ class ProbeStatusController:
         self, nb: Notebook, chips_visible: int, mesh_ready: bool, newly_ready: bool
     ) -> None:
         def attempt():
-            cur = self.client.get(Notebook, nb.metadata.namespace, nb.metadata.name)
+            cur = self.api_reader.get(Notebook, nb.metadata.namespace, nb.metadata.name)
             tpu = cur.status.tpu or TPUStatus()
             changed = (
                 tpu.chips_visible != chips_visible or tpu.mesh_ready != mesh_ready

@@ -1,0 +1,82 @@
+"""Cache-backed typed client — controller-runtime's split client semantics.
+
+The reference's reconcilers read through mgr.GetClient(), which serves GETs
+and LISTs from the shared informer caches and sends writes straight to the
+apiserver; only mgr.GetAPIReader() bypasses the cache. This mirrors that
+split exactly: for kinds that have a (synced) informer, reads come from the
+informer's store — no API round-trip, which is the difference between ~10^3
+requests per reconcile storm and ~10^1 against a real apiserver (measured by
+the loadtest's client_throttle stats) — and for everything else reads fall
+through to the live store. Writes always go direct.
+
+Staleness contract (same as controller-runtime): a reconciler may observe a
+cache that does not yet include its own last write; every write path that
+read-modify-writes must use retry_on_conflict with a FRESH read, which is
+what the `api_reader` (uncached Client) is for.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from ..apimachinery import KubeObject, NotFoundError, Scheme, default_scheme, match_labels
+from ..cluster.client import Client, T
+from ..cluster.store import Store
+from .informer import InformerRegistry
+
+
+class CachedClient(Client):
+    def __init__(
+        self,
+        store: Store,
+        scheme: Scheme = default_scheme,
+        informers: Optional[InformerRegistry] = None,
+    ):
+        super().__init__(store, scheme)
+        self.informers = informers
+
+    def _cache_for(self, cls: Type[KubeObject]):
+        """The informer to serve this kind from, or None for a direct read.
+        Only EXISTING informers are consulted — reads must not implicitly
+        spin up watches for kinds no controller asked to watch (controller-
+        runtime does auto-start them; here the watch set is the Builder's
+        explicit For/Owns/Watches topology, and a lazily-started informer
+        would race its own initial sync)."""
+        if self.informers is None:
+            return None
+        av, kind = self._av_kind(cls)
+        inf = self.informers._informers.get((av, kind))
+        if inf is None or not inf.synced.is_set():
+            return None
+        return inf
+
+    def get(self, cls: Type[T], namespace: str, name: str) -> T:
+        inf = self._cache_for(cls)
+        if inf is None:
+            return super().get(cls, namespace, name)
+        obj = inf.get(namespace, name)
+        if obj is None:
+            # the cache is authoritative for watched kinds (controller-runtime
+            # returns NotFound from cache too; falling through would turn
+            # every informer-lag miss into an API GET storm)
+            av, kind = self._av_kind(cls)
+            raise NotFoundError(f"{kind} {namespace}/{name} not found (cache)")
+        return self._decode(cls, obj)
+
+    def list(
+        self,
+        cls: Type[T],
+        namespace: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> List[T]:
+        inf = self._cache_for(cls)
+        if inf is None:
+            return super().list(cls, namespace=namespace, labels=labels)
+        out = []
+        for obj in inf.list():
+            meta = obj.get("metadata", {})
+            if namespace is not None and meta.get("namespace", "") != namespace:
+                continue
+            if labels is not None and not match_labels(labels, meta.get("labels")):
+                continue
+            out.append(self._decode(cls, obj))
+        return out

@@ -156,11 +156,25 @@ class Manager:
         leader_election: bool = False,
         leader_election_id: str = "tpu-notebook-controller",
         metrics_registry: Optional[Registry] = None,
+        cached_reads: bool = True,
     ):
         self.store = store
         self.scheme = scheme
-        self.client = Client(store, scheme)
         self.informers = InformerRegistry(store, scheme)
+        # controller-runtime's split client: reconciler reads serve from the
+        # informer caches (mgr.GetClient()); api_reader bypasses the cache
+        # (mgr.GetAPIReader()) for read-modify-write freshness.
+        # cached_reads=False keeps every read direct — the sim's SYSTEM
+        # manager (scheduler/statefulset/kubelet, the cluster side) uses it:
+        # those controllers make destructive decisions (pod deletes) where
+        # kube's real counterparts read authoritative etcd state
+        self.api_reader = Client(store, scheme)
+        if cached_reads:
+            from .cached_client import CachedClient
+
+            self.client: Client = CachedClient(store, scheme, self.informers)
+        else:
+            self.client = self.api_reader
         self.metrics = metrics_registry or global_registry
         self.controllers: List[Controller] = []
         self._runnables: List[Callable[[], None]] = []  # extra start hooks

@@ -245,3 +245,56 @@ def test_healthz_reports_dead_controller_thread():
 
     mgr.controllers.append(FakeCtrl())
     assert mgr.healthz() is False
+
+
+def test_cached_client_split_semantics():
+    """controller-runtime split client: reads of WATCHED kinds serve from the
+    informer cache (authoritative: miss = NotFound, no API fallthrough);
+    unwatched kinds read straight through; api_reader always bypasses."""
+    import pytest as _pytest
+
+    from odh_kubeflow_tpu.api.core import ConfigMap, Service
+    from odh_kubeflow_tpu.apimachinery import NotFoundError
+    from odh_kubeflow_tpu.cluster.store import Store
+    from odh_kubeflow_tpu.runtime.manager import Manager
+
+    store = Store()
+    mgr = Manager(store)
+    inf = mgr.informers.informer_for(ConfigMap)  # ConfigMap is now "watched"
+    mgr.informers.start_all()
+    try:
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "a", "namespace": "ns", "labels": {"x": "1"}},
+            "data": {"k": "v"},
+        }
+        store.create_raw(cm)
+        deadline = time.time() + 5
+        while inf.get("ns", "a") is None and time.time() < deadline:
+            time.sleep(0.01)
+
+        got = mgr.client.get(ConfigMap, "ns", "a")
+        assert got.data == {"k": "v"}
+        # cache-authoritative: a cache miss raises, even though the store
+        # would answer (simulate lag by asking before any event could exist)
+        with _pytest.raises(NotFoundError):
+            mgr.client.get(ConfigMap, "ns", "nope")
+        # label + namespace filtering on cached lists
+        assert len(mgr.client.list(ConfigMap, namespace="ns", labels={"x": "1"})) == 1
+        assert mgr.client.list(ConfigMap, namespace="other") == []
+        assert mgr.client.list(ConfigMap, namespace="ns", labels={"x": "2"}) == []
+
+        # UNWATCHED kind: falls through to the store
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": "s", "namespace": "ns"},
+            "spec": {},
+        }
+        store.create_raw(svc)
+        assert mgr.client.get(Service, "ns", "s").metadata.name == "s"
+        # api_reader bypasses the cache for watched kinds too
+        assert mgr.api_reader.get(ConfigMap, "ns", "a").metadata.name == "a"
+    finally:
+        mgr.informers.stop_all()
