@@ -267,18 +267,27 @@ class TPUWorkbenchReconciler:
                 pass
             return
         desired_data = {"ca-bundle.crt": "\n".join(parts) + "\n"}
-        try:
-            cur = self.client.get(ConfigMap, nb.metadata.namespace, CA_BUNDLE_CONFIGMAP)
+
+        def attempt():
+            # shared per-namespace object, multiple concurrent reconcilers:
+            # fresh read + conflict retry (a cached RV here 409s uncaught)
+            try:
+                cur = self.api_reader.get(
+                    ConfigMap, nb.metadata.namespace, CA_BUNDLE_CONFIGMAP
+                )
+            except NotFoundError:
+                cm = ConfigMap()
+                cm.metadata.name = CA_BUNDLE_CONFIGMAP
+                cm.metadata.namespace = nb.metadata.namespace
+                cm.metadata.labels = {"app.kubernetes.io/part-of": "tpu-notebooks"}
+                cm.data = desired_data
+                self._create(cm)
+                return
             if cur.data != desired_data:
                 cur.data = desired_data
                 self.client.update(cur)
-        except NotFoundError:
-            cm = ConfigMap()
-            cm.metadata.name = CA_BUNDLE_CONFIGMAP
-            cm.metadata.namespace = nb.metadata.namespace
-            cm.metadata.labels = {"app.kubernetes.io/part-of": "tpu-notebooks"}
-            cm.data = desired_data
-            self._create(cm)
+
+        retry_on_conflict(attempt)
 
     # ================= network policies =================
 

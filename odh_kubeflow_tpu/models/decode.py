@@ -110,12 +110,13 @@ def _cached_attention_flat(q, k_cache, v_cache, valid, cfg: TransformerConfig):
     ) * (cfg.head_dim**-0.5)  # (b*c, g, max_seq)
     scores = jnp.where(valid[None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    # f32 probs against the (converted) cache — the same promotion the
-    # batch-major einsum performs, so generate() and decode_step() stay
-    # numerically identical (bf16 probs can flip greedy argmax on near-ties)
-    attn = lax.dot_general(
-        probs, v_cache.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
+    # f32 probs against the bf16 cache via einsum — the same mixed-dtype
+    # promotion the batch-major path performs (generate() == decode_step()
+    # numerically; bf16 probs can flip greedy argmax on near-ties), with the
+    # convert fused into the contraction rather than an explicit astype that
+    # could materialize a f32 copy of a large cache
+    attn = jnp.einsum(
+        "bgk,bkd->bgd", probs, v_cache, preferred_element_type=jnp.float32
     ).astype(cfg.dtype)  # (b*c, g, hd)
     return attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
 

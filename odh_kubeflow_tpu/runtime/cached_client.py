@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Type
 
-from ..apimachinery import KubeObject, NotFoundError, Scheme, default_scheme, match_labels
+from ..apimachinery import KubeObject, NotFoundError, Scheme, default_scheme
 from ..cluster.client import Client, T
 from ..cluster.store import Store
 from .informer import InformerRegistry
@@ -71,12 +71,9 @@ class CachedClient(Client):
         inf = self._cache_for(cls)
         if inf is None:
             return super().list(cls, namespace=namespace, labels=labels)
-        out = []
-        for obj in inf.list():
-            meta = obj.get("metadata", {})
-            if namespace is not None and meta.get("namespace", "") != namespace:
-                continue
-            if labels is not None and not match_labels(labels, meta.get("labels")):
-                continue
-            out.append(self._decode(cls, obj))
-        return out
+        # filtering happens inside the informer on the raw dicts, before the
+        # defensive deepcopy
+        return [
+            self._decode(cls, obj)
+            for obj in inf.list(namespace=namespace, labels=labels)
+        ]

@@ -90,11 +90,24 @@ class Informer:
             obj = self._cache.get(key)
             return copy.deepcopy(obj) if obj else None
 
-    def list(self) -> List[dict]:
+    def list(self, namespace: Optional[str] = None, labels: Optional[dict] = None) -> List[dict]:
+        """Snapshot of matching objects. Filters apply on the RAW cached
+        dicts BEFORE the defensive deepcopy — a label-filtered list must not
+        pay for copies of every non-matching object cluster-wide."""
         import copy
 
+        from ..apimachinery import match_labels
+
         with self._lock:
-            return [copy.deepcopy(o) for o in self._cache.values()]
+            out = []
+            for o in self._cache.values():
+                meta = o.get("metadata", {})
+                if namespace is not None and meta.get("namespace", "") != namespace:
+                    continue
+                if labels is not None and not match_labels(labels, meta.get("labels")):
+                    continue
+                out.append(copy.deepcopy(o))
+            return out
 
 
 class InformerRegistry:
