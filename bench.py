@@ -295,7 +295,9 @@ def bench_decode():
         "decode_only_tokens_per_s": round(batch * (max_new - 1) / decode_s),
         "decode_per_token_ms": round(decode_s / (max_new - 1) * 1e3, 2),
         "hbm_util_est": round(hbm_util, 3),
-        "prefill_ms": round(prefill_s * 1e3, 1),
+        # derived as t_long - decode_s: carries ONE tunnel round-trip
+        # (~90-120 ms) on top of the actual prompt forward
+        "prefill_ms_incl_tunnel_rtt": round(prefill_s * 1e3, 1),
         "batch": batch,
         "prompt_len": prompt_len,
         "max_new": max_new,
