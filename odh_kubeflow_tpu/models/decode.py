@@ -95,19 +95,25 @@ def _cached_attention(q, k_cache, v_cache, valid, cfg: TransformerConfig):
 
 
 def _cached_attention_flat(q, k_cache, v_cache, valid, cfg: TransformerConfig):
-    """_cached_attention against FLAT (batch·kv_heads, max_seq, head_dim)
-    caches — the generate loop's layout. Each (batch, head) slab is
+    """_cached_attention against FLAT (kv_heads·batch, max_seq, head_dim)
+    caches — the generate loop's layout. Each (head, batch) slab is
     contiguous, so the score/value contractions stream the cache at full HBM
     bandwidth (measured 707 vs 499 GB/s for the 4-D batch-strided einsum at
-    8k-token caches)."""
+    8k-token caches). KV-HEAD-major (head outermost) so a tp shard of dim 0
+    is a whole-heads slab: sharded decode splits cleanly on kv heads
+    (VERDICT r4 #5)."""
     b = q.shape[0]
     c, groups = cfg.kv_heads, cfg.n_heads // cfg.kv_heads
-    # (b, 1, h, hd) -> (b*c, g, hd); head j groups with kv head j//g
-    qf = q.reshape(b, c, groups, cfg.head_dim).reshape(b * c, groups, cfg.head_dim)
+    # (b, 1, h, hd) -> (c*b, g, hd); head j groups with kv head j//g
+    qf = (
+        q.reshape(b, c, groups, cfg.head_dim)
+        .transpose(1, 0, 2, 3)
+        .reshape(c * b, groups, cfg.head_dim)
+    )
     scores = lax.dot_general(
         qf, k_cache, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
-    ) * (cfg.head_dim**-0.5)  # (b*c, g, max_seq)
+    ) * (cfg.head_dim**-0.5)  # (c*b, g, max_seq)
     scores = jnp.where(valid[None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     # f32 probs against the bf16 cache via einsum — the same mixed-dtype
@@ -117,8 +123,12 @@ def _cached_attention_flat(q, k_cache, v_cache, valid, cfg: TransformerConfig):
     # could materialize a f32 copy of a large cache
     attn = jnp.einsum(
         "bgk,bkd->bgd", probs, v_cache, preferred_element_type=jnp.float32
-    ).astype(cfg.dtype)  # (b*c, g, hd)
-    return attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
+    ).astype(cfg.dtype)  # (c*b, g, hd)
+    return (
+        attn.reshape(c, b, groups, cfg.head_dim)
+        .transpose(1, 0, 2, 3)
+        .reshape(b, 1, cfg.n_heads, cfg.head_dim)
+    )
 
 
 def _decode_layer(h, layer_params, k_cache, v_cache, positions, valid, pos, cfg,
@@ -130,8 +140,9 @@ def _decode_layer(h, layer_params, k_cache, v_cache, positions, valid, pos, cfg,
     q, k, v = layer_qkv(h, layer_params, positions, cfg)  # q: (b,1,h,hd)
     if seq_major:
         b = k.shape[0]
-        kf = k.reshape(b * cfg.kv_heads, 1, cfg.head_dim)
-        vf = v.reshape(b * cfg.kv_heads, 1, cfg.head_dim)
+        # (b, 1, c, hd) -> kv-head-major (c*b, 1, hd)
+        kf = k.transpose(2, 0, 1, 3).reshape(cfg.kv_heads * b, 1, cfg.head_dim)
+        vf = v.transpose(2, 0, 1, 3).reshape(cfg.kv_heads * b, 1, cfg.head_dim)
         k_cache = lax.dynamic_update_slice(k_cache, kf, (0, pos, 0))
         v_cache = lax.dynamic_update_slice(v_cache, vf, (0, pos, 0))
         attn = _cached_attention_flat(q, k_cache, v_cache, valid, cfg)
@@ -221,15 +232,16 @@ def decode_step(
 def _prefill_parts(params, tokens, cfg: TransformerConfig, max_seq: int):
     """Prompt forward returning last-position logits and PER-LAYER cache
     buffers — the generate-loop layout: separate buffers per layer (so the
-    token-scan carry aliases them), FLAT (batch·kv_heads, max_seq, head_dim)
-    so every (batch, head) slab is contiguous and the per-token attention
-    contractions stream at full HBM bandwidth (_cached_attention_flat)."""
+    token-scan carry aliases them), FLAT (kv_heads·batch, max_seq, head_dim)
+    so every (head, batch) slab is contiguous and the per-token attention
+    contractions stream at full HBM bandwidth (_cached_attention_flat);
+    kv-head-major so a tp shard of dim 0 is a whole-heads slab."""
     b, s = tokens.shape
     logits, ks, vs = _prompt_scan(params, tokens, cfg)
-    shape = (b * cfg.kv_heads, max_seq, cfg.head_dim)
+    shape = (cfg.kv_heads * b, max_seq, cfg.head_dim)
 
-    def flat(x):  # (b, s, c, d) -> (b*c, s, d)
-        return x.transpose(0, 2, 1, 3).reshape(b * cfg.kv_heads, s, cfg.head_dim)
+    def flat(x):  # (b, s, c, d) -> (c*b, s, d)
+        return x.transpose(2, 0, 1, 3).reshape(cfg.kv_heads * b, s, cfg.head_dim)
 
     caches = tuple(
         (
@@ -241,10 +253,29 @@ def _prefill_parts(params, tokens, cfg: TransformerConfig, max_seq: int):
     return logits, caches
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "max_seq", "sample"))
-def _generate_impl(params, prompt, rng, temperature, cfg, max_new, max_seq, sample):
+def _cache_constrainer(cfg: TransformerConfig, mesh):
+    """Sharding constraint for the flat (kv_heads·batch, max_seq, head_dim)
+    cache buffers: kv heads (dim 0, head-major) shard over tp — each device
+    owns whole heads' contiguous slabs and the per-token attention
+    contractions stay fully local (scores/probs/values never cross tp)."""
+    if mesh is None:
+        return lambda t: t
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if sizes.get("tp", 1) <= 1 or cfg.kv_heads % sizes["tp"]:
+        return lambda t: t
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = NamedSharding(mesh, PartitionSpec("tp"))
+    return lambda t: lax.with_sharding_constraint(t, sh)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new", "max_seq", "sample", "mesh"))
+def _generate_impl(params, prompt, rng, temperature, cfg, max_new, max_seq, sample,
+                   mesh=None):
     b, s = prompt.shape
+    shard_cache = _cache_constrainer(cfg, mesh)
     logits, caches = _prefill_parts(params, prompt, cfg, max_seq)
+    caches = tuple((shard_cache(k), shard_cache(v)) for k, v in caches)
     # per-layer weight views, sliced ONCE (loop-invariant: every decode step
     # re-reads these buffers instead of re-slicing the (L, ...) stack).
     # Dense FFN halves are pre-concatenated into one (d, 2f) weight so each
@@ -282,7 +313,7 @@ def _generate_impl(params, prompt, rng, temperature, cfg, max_new, max_seq, samp
                 x, layer_params, k_cache, v_cache, positions, valid, pos, cfg,
                 seq_major=True,
             )
-            new_caches.append((k_cache, v_cache))
+            new_caches.append((shard_cache(k_cache), shard_cache(v_cache)))
         x = rms_norm(x, params["final_norm"])
         step_logits = jnp.einsum(
             "bd,dv->bv", x[:, 0], params["unembed"],
@@ -308,11 +339,18 @@ def generate(
     max_seq: int = 0,
     rng: Optional[jnp.ndarray] = None,
     temperature: float = 0.0,
+    mesh=None,
 ) -> jnp.ndarray:
     """Greedy (temperature 0) or sampled generation: (batch, prompt_len) ->
     (batch, max_new) new tokens. One compiled program: prefill + a scanned
     decode loop. Only greedy-vs-sampled is a compile-time switch; the
-    temperature VALUE is a runtime operand."""
+    temperature VALUE is a runtime operand.
+
+    With `mesh`, generation runs tensor-parallel on the slice (VERDICT r4
+    #5): pass params device_put per `param_specs(cfg, mesh)` — the KV cache
+    shards over tp on its kv-head dim (_cache_constrainer), attention stays
+    fully local per shard, and the unembed logits matmul shards over vocab
+    exactly as in training (GSPMD inserts the gather before argmax)."""
     b, s = prompt.shape
     if max_new <= 0:
         return jnp.zeros((b, 0), jnp.int32)
@@ -334,4 +372,5 @@ def generate(
         max_new,
         max_seq,
         sample,
+        mesh,
     )

@@ -2,50 +2,88 @@
 
 Long-context is first-class (SURVEY §5 calls slice scaling the long-context
 analog; here it is literal): each device holds a contiguous (batch, seq/sp)
-shard of Q, K, V. K/V blocks rotate around the `sp` ring with lax.ppermute
-while every device folds each visiting block into an online-softmax carry
-(m, l, acc) — so the ICI transfer of step i+1 overlaps the MXU work of step i
-and no device ever materializes more than one remote K/V block. Causal
-masking uses global positions, so shards early in the sequence simply
-contribute fully-masked (skipped-cost) blocks.
+shard of Q and a GQA-width (batch, seq/sp, kv_heads, head_dim) shard of K/V.
+K/V blocks rotate around the `sp` ring with lax.ppermute while every device
+folds each visiting block into a normalized (out, lse) carry — the ICI
+transfer of step i+1 overlaps the MXU work of step i and no device ever
+holds more than one remote K/V block.
 
-Built on shard_map + XLA collectives, not an NCCL port; the per-step local
-attention is the same online-softmax math as ops/attention.py.
+Flash-grade (VERDICT r3 next #3): the per-visit block IS the pallas flash
+kernel (ops/attention.py), so no (sq, sk) f32 score matrix ever
+materializes and K/V are never expanded to the full head count. The causal
+structure makes this composition exact with zero new kernel code:
+
+- the visit from the device's own shard is the standard *causal* kernel
+  (the diagonal block),
+- visits from strictly-earlier shards need *no mask at all* — the plain
+  non-causal kernel,
+- visits from later shards are fully masked — skipped entirely (a
+  lax.cond arm that returns the identity merge), paying neither MXU nor
+  HBM cost.
+
+Blocks merge by log-sum-exp: out' = (w·out + w_b·out_b)/(w + w_b) with
+w = exp(lse − m); a fully-masked block has lse_b = −inf and merges as the
+identity. The backward is a second ring pass: with the GLOBAL lse and
+delta = rowsum(do ⊙ o), each visit's (dq, dk, dv) comes from the flash
+backward kernels directly (the FlashAttention-2 decomposition is exact
+under partitioned K), dq accumulating locally while dk/dv accumulators
+ride the ring alongside their K/V shard — after a full cycle every
+gradient is home.
+
+Off-TPU (the CPU test mesh) an einsum path with the same GQA-native math
+runs instead, blockwise per visiting shard, under plain autodiff.
 """
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .attention import NEG_INF
+from .attention import NEG_INF, _fit_block, _flash_backward, _flash_forward_kernel
+
+
+# ---------------------------------------------------------------------------
+# Reference path (off-TPU): GQA-native online-softmax einsums
+# ---------------------------------------------------------------------------
 
 
 def _local_block(q, k, v, q_off, k_off, causal, sm_scale):
-    """One (local Q) x (visiting K/V) block: returns (m, l, acc) in f32.
-    q: (b, sq, h, d); k/v: (b, sk, h, d); offsets are global positions."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    s = s * sm_scale
+    """One (local Q) x (visiting K/V) block: returns (m, l, acc) in f32,
+    grouped layout. q: (b, sq, h, d); k/v: (b, sk, hk, d) with h % hk == 0 —
+    K/V are consumed at kv_heads width (never expanded). Offsets are global
+    positions."""
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    g = h // hk
+    qg = q.reshape(b, sq, hk, g, d)
+    s = jnp.einsum(
+        "bqkgd,bnkd->bkgqn", qg, k, preferred_element_type=jnp.float32
+    ) * sm_scale
     if causal:
         qpos = q_off + jnp.arange(q.shape[1])
         kpos = k_off + jnp.arange(k.shape[1])
-        s = jnp.where((qpos[:, None] >= kpos[None, :])[None, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)  # (b, h, sq, 1)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (b, hk, g, sq, 1)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    acc = jnp.einsum("bkgqn,bnkd->bkgqd", p, v.astype(jnp.float32))
     return m, l, acc
 
 
-def _ring_body(q, k, v, axis_name: str, causal: bool):
+def _ring_reference(q, k, v, axis_name: str, causal: bool):
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, sq, h, d = q.shape
+    hk = k.shape[2]
+    g = h // hk
     sm_scale = d**-0.5
 
-    m0 = jnp.full((b, h, sq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
-    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, hk, g, sq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hk, g, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hk, g, sq, d), jnp.float32)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def fold(i, m, l, acc, k_cur, v_cur):
@@ -72,12 +110,194 @@ def _ring_body(q, k, v, axis_name: str, causal: bool):
         carry = lax.fori_loop(0, axis_size - 1, step, carry)
     m, l, acc, k_last, v_last = carry
     m, l, acc = fold(axis_size - 1, m, l, acc, k_last, v_last)
-    out = acc / jnp.maximum(l, 1e-30)  # (b, h, sq, d)
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    out = acc / jnp.maximum(l, 1e-30)  # (b, hk, g, sq, d)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, h, d).astype(q.dtype)
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+# ---------------------------------------------------------------------------
+# Kernel path (TPU): pallas flash blocks + (out, lse) merge, custom VJP
+# ---------------------------------------------------------------------------
+
+
+def _lse_to_bsh(lse_k, b, hk, g, sq):
+    """Kernel-layout lse (b*hk, group, sq, 128) -> (b, sq, h) f32."""
+    slim = lse_k[..., 0].reshape(b, hk * g, sq)
+    return slim.transpose(0, 2, 1)
+
+
+def _lse_to_kernel(lse, b, hk, g, sq):
+    """(b, sq, h) -> lane-broadcast kernel layout (b*hk, group, sq, 128)."""
+    slim = lse.transpose(0, 2, 1).reshape(b * hk, g, sq)
+    return jnp.broadcast_to(slim[..., None], (b * hk, g, sq, 128))
+
+
+def _merge(out, lse, out_b, lse_b):
+    """Fold a visiting block's normalized (out_b, lse_b) into the carry."""
+    m = jnp.maximum(lse, lse_b)
+    w = jnp.exp(lse - m)
+    wb = jnp.exp(lse_b - m)
+    denom = w + wb
+    out = (out * w[..., None] + out_b * wb[..., None]) / denom[..., None]
+    return out, m + jnp.log(denom)
+
+
+def _block_sizes(sq, sk):
+    return _fit_block(1024, sq), _fit_block(1024, sk)
+
+
+def _ring_kernel_fwd_impl(q, k, v, axis_name, causal, interpret):
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    g = h // hk
+    bq, bk = _block_sizes(sq, sk)
+
+    def block(kc, vc, blk_causal):
+        out_b, lse_k = _flash_forward_kernel(
+            q, kc, vc, blk_causal, bq, bk, interpret, with_lse=True
+        )
+        return out_b.astype(jnp.float32), _lse_to_bsh(lse_k, b, hk, g, sq)
+
+    def skip():
+        return (
+            jnp.zeros((b, sq, h, d), jnp.float32),
+            jnp.full((b, sq, h), NEG_INF, jnp.float32),
+        )
+
+    # visit 0 — the device's own shard: the causal diagonal block (or a
+    # plain full block for non-causal rings). Initializes the carry.
+    out, lse = block(k, v, causal)
+    if axis_size == 1:
+        return out.astype(q.dtype), lse
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    k0 = lax.ppermute(k, axis_name, perm)
+    v0 = lax.ppermute(v, axis_name, perm)
+
+    def fold(i, out, lse, kc, vc):
+        src = (my_idx - i) % axis_size
+        if causal:
+            # earlier shard: mask-free full block; later shard: fully
+            # masked — skip pays neither MXU nor HBM cost
+            out_b, lse_b = lax.cond(
+                src < my_idx, lambda: block(kc, vc, False), skip
+            )
+        else:
+            out_b, lse_b = block(kc, vc, False)
+        return _merge(out, lse, out_b, lse_b)
+
+    def step(i, carry):
+        out, lse, kc, vc = carry
+        out, lse = fold(i, out, lse, kc, vc)
+        # rotate AFTER the fold: the transfer is independent of the fold's
+        # outputs, so XLA overlaps it with the block compute
+        return (out, lse, lax.ppermute(kc, axis_name, perm),
+                lax.ppermute(vc, axis_name, perm))
+
+    out, lse, k_last, v_last = lax.fori_loop(
+        1, axis_size - 1, step, (out, lse, k0, v0)
+    )
+    out, lse = fold(axis_size - 1, out, lse, k_last, v_last)
+    return out.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_kernel(q, k, v, axis_name, causal, interpret):
+    return _ring_kernel_fwd_impl(q, k, v, axis_name, causal, interpret)[0]
+
+
+def _ring_kernel_fwd(q, k, v, axis_name, causal, interpret):
+    out, lse = _ring_kernel_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_kernel_bwd(axis_name, causal, interpret, res, grad):
+    """Second ring pass: every visit runs the flash backward kernels with
+    the GLOBAL lse (so recomputed p are the true global probabilities —
+    the FlashAttention-2 decomposition is exact under partitioned K).
+    dq accumulates locally; (dk, dv) accumulators ride the ring alongside
+    their K/V shard and arrive home after the full cycle."""
+    q, k, v, out, lse = res
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    g = h // hk
+    bq, bk = _block_sizes(sq, sk)
+    lse_k = _lse_to_kernel(lse, b, hk, g, sq)
+    grad = grad.astype(q.dtype)
+
+    def block_bwd(kc, vc, blk_causal):
+        return _flash_backward(
+            q, kc, vc, out, lse_k, grad, blk_causal, bq, bk, interpret
+        )
+
+    def skip():
+        return (
+            jnp.zeros((b, sq, h, d), q.dtype),
+            jnp.zeros((b, sk, hk, d), k.dtype),
+            jnp.zeros((b, sk, hk, d), v.dtype),
+        )
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def fold(i, kc, vc):
+        if not causal:
+            return block_bwd(kc, vc, False)
+        src = (my_idx - i) % axis_size
+        return lax.switch(
+            # 0: later shard (skip), 1: earlier shard (mask-free), 2: own
+            # shard (causal diagonal)
+            jnp.where(src == my_idx, 2, jnp.where(src < my_idx, 1, 0)),
+            [skip, lambda: block_bwd(kc, vc, False),
+             lambda: block_bwd(kc, vc, True)],
+        )
+
+    def step(i, carry):
+        dq, dk_acc, dv_acc, kc, vc = carry
+        dq_b, dk_b, dv_b = fold(i, kc, vc)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk_acc = dk_acc + dk_b.astype(jnp.float32)
+        dv_acc = dv_acc + dv_b.astype(jnp.float32)
+        # rotate gradient accumulators WITH their K/V shard: after the full
+        # axis_size-rotation cycle both are back on the owning device
+        rot = lambda x: lax.ppermute(x, axis_name, perm)
+        return dq, rot(dk_acc), rot(dv_acc), rot(kc), rot(vc)
+
+    dq0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    dkv0 = jnp.zeros((b, sk, hk, d), jnp.float32)
+    dq, dk, dv, _, _ = lax.fori_loop(
+        0, axis_size, step, (dq0, dkv0, dkv0, k, v)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_kernel.defvjp(_ring_kernel_fwd, _ring_kernel_bwd)
+
+
+def ring_attention(
+    q, k, v, axis_name: str = "sp", causal: bool = True, interpret: bool = False
+):
     """Attention over seq shards. Call INSIDE shard_map/pjit over a mesh with
-    `axis_name`; q/k/v are the local (batch, local_seq, heads, head_dim)
-    shards in sequence order (shard i holds positions [i*local_seq, ...))."""
-    return _ring_body(q, k, v, axis_name, causal)
+    `axis_name`; q is the local (batch, local_seq, heads, head_dim) shard and
+    k/v the local (batch, local_seq, kv_heads, head_dim) shards in sequence
+    order (shard i holds positions [i*local_seq, ...)). GQA runs natively —
+    K/V rotate the ring at kv_heads width and are never expanded."""
+    from ..tpu.detect import tpu_like
+
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    bq, bk = _block_sizes(sq, sk)
+    use_kernel = (
+        (tpu_like() or interpret)
+        and h % hk == 0
+        and sq % bq == 0
+        and sk % bk == 0
+        and bq >= 8
+        and bk >= 128
+        and sq == sk
+    )
+    if use_kernel:
+        return _ring_kernel(q, k, v, axis_name, causal, interpret)
+    return _ring_reference(q, k, v, axis_name, causal)

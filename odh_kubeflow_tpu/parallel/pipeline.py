@@ -9,15 +9,26 @@ n_micro / (n_micro + S - 1).
 
 Implemented with a fully-manual `jax.shard_map` over the mesh: stage params
 shard over pp, activations shard over the data axes (dp/fsdp) and replicate
-elsewhere, so pipeline composes with data parallelism directly (tensor/
-sequence parallelism inside a stage would need nested manual collectives —
-future work). Everything (ppermute, masked scatter, psum broadcast) is
-differentiable, so the same function trains.
+elsewhere. Pipeline composes with the other axes:
+
+- **dp/fsdp on activations** directly (batch sharding);
+- **tp inside stages**: the stage_fn may run manual tensor parallelism
+  (per-shard head/mlp widths + psum at row-parallel projections — see
+  models/transformer.pp_forward), with stage weights stored tp-sharded;
+- **ZeRO stage storage**: stage weights may additionally be stored
+  fsdp-sharded; `param_prepare` all-gathers them ONCE per shard_map call
+  (not per microbatch step), and the gather's transpose reduce-scatters the
+  gradients — optimizer state shards with the params;
+- **ep inside stages**: MoE expert weights keep their ep shard
+  (manual-collective MoE, models/moe._moe_ffn_manual).
+
+Everything (ppermute, masked scatter, psum broadcast) is differentiable, so
+the same function trains.
 """
 from __future__ import annotations
 
 import math
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +45,7 @@ def pipeline_apply(
     axis: str = "pp",
     with_aux: bool = False,
     param_specs: Any = None,
+    param_prepare: Optional[Callable[[Any], Any]] = None,
 ):
     """Run stage-stacked parameters as a microbatched pipeline.
 
@@ -45,7 +57,10 @@ def pipeline_apply(
     sharded over dp/fsdp as usual);
     param_specs: optional PartitionSpec pytree for stage_params leaves whose
     sharding goes beyond P(axis) — e.g. MoE expert weights keeping their ep
-    shard inside the stage (manual-collective MoE).
+    shard, or dense weights stored tp/fsdp-sharded;
+    param_prepare: optional transform applied ONCE to the local stage params
+    inside the shard_map, before the microbatch loop — the ZeRO all-gather
+    hook (its AD transpose reduce-scatters the gradients).
 
     Returns the last stage's outputs, replicated over `axis` (plus, with
     with_aux, the aux scalars summed over stages and real microbatches —
@@ -64,6 +79,8 @@ def pipeline_apply(
 
     def per_stage(params_local, x_local):
         params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        if param_prepare is not None:
+            params_local = param_prepare(params_local)
         rank = lax.axis_index(axis)
         batch = x_local.shape[0]
         mb = batch // n_micro
@@ -122,3 +139,240 @@ def stack_stages(layer_params: Any, n_stages: int) -> Any:
         return p.reshape(n_stages, L // n_stages, *p.shape[1:])
 
     return jax.tree_util.tree_map(reshape, layer_params)
+
+
+def pipeline_value_and_grad_1f1b(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    loss_head: Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    head_params: Any,
+    x: jnp.ndarray,
+    targets: jnp.ndarray,
+    mesh,
+    n_micro: int,
+    axis: str = "pp",
+    param_specs: Any = None,
+    param_prepare: Optional[Callable[[Any], Any]] = None,
+    tp_axis: str = "",
+):
+    """1F1B pipeline schedule: loss AND gradients in one interleaved pass.
+
+    GPipe (pipeline_apply + autodiff) holds every microbatch's stage
+    activations live until the backward wave — O(n_micro) activation memory
+    per device. 1F1B interleaves each microbatch's backward as soon as the
+    last stage finishes its forward, so at most 2(S-1)+1 stage INPUTS are in
+    flight per device — O(S), independent of n_micro — enabling the large
+    n_micro that actually amortizes the pipeline bubble (bubble fraction
+    2(S-1)/(n_micro + 2(S-1)) here vs GPipe's (S-1)/(n_micro + S - 1) on
+    each of its two waves; at equal n_micro wall-clock is comparable, the
+    win is memory -> larger feasible n_micro).
+
+    Lockstep-SPMD schedule: one (masked) forward AND one (masked) backward
+    stage computation per step over T = n_micro + 2(S-1) steps — forward of
+    microbatch i at step t = i + r on stage r, backward at
+    t = i + 2(S-1) - r. The last stage seeds its own cotangent (loss_head
+    fwd + vjp inline, the same step as its forward: the "1F" immediately
+    followed by its "1B"). The stage backward RECOMPUTES the stage from its
+    saved input (jax.vjp at consume time) — activation checkpointing at
+    stage boundaries, the standard 1F1B-with-remat profile.
+
+    Not itself differentiable: returns (loss, d_stage_params, d_head_params,
+    dx) directly, loss being the microbatch-and-data-shard mean of
+    loss_head's per-microbatch MEAN loss. Composes with pipeline_apply's
+    stage layouts: param_prepare runs INSIDE the per-visit vjp, so
+    ZeRO-stored weights all-gather forward and reduce-scatter their
+    gradients via the transpose; tp_axis marks stage compute as
+    tensor-partitioned so replicated-leaf gradients psum over tp. head
+    params enter replicated (P()). The aux-loss channel is not threaded —
+    MoE configs keep the GPipe schedule.
+    """
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_stages = sizes[axis]
+    if n_stages == 1:
+        raise ValueError("1F1B needs pp > 1; run the unpipelined path at pp == 1")
+    data_axes = tuple(a for a in ("dp", "fsdp") if sizes.get(a, 1) > 1)
+    n_data = math.prod(sizes[a] for a in data_axes) if data_axes else 1
+    local_batch = x.shape[0] // max(1, n_data)
+    if local_batch % n_micro:
+        raise ValueError(
+            f"per-data-shard batch {local_batch} not divisible by n_micro {n_micro}"
+        )
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    live_tp = tp_axis and sizes.get(tp_axis, 1) > 1
+
+    def grad_sum_axes(spec):
+        """Mesh axes to psum a stage-leaf gradient over: every axis whose
+        compute is partitioned but whose storage does NOT already hold
+        distinct per-device shards. fsdp-STORED leaves got their cross-shard
+        sum from the all-gather transpose (psum_scatter); tp-stored leaves
+        own distinct head/mlp shards; replicated leaves need explicit sums
+        over both the data axes and (when stage compute is tensor-
+        partitioned) tp."""
+        named = set()
+        for part in spec:
+            if part is None:
+                continue
+            named.update((part,) if isinstance(part, str) else tuple(part))
+        axes = [a for a in data_axes if a not in named]
+        if live_tp and tp_axis not in named:
+            axes.append(tp_axis)
+        return tuple(axes)
+
+    W = 2 * (n_stages - 1) + 1  # max in-flight stage inputs per device
+    last = n_stages - 1
+    T = n_micro + 2 * (n_stages - 1)
+
+    def per_device(stage_params, head_params, x_local, tgt_local):
+        stage_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        rank = lax.axis_index(axis)
+        batch = x_local.shape[0]
+        mb = batch // n_micro
+        micros = x_local.reshape(n_micro, mb, *x_local.shape[1:])
+        tgt_micros = tgt_local.reshape(n_micro, mb, *tgt_local.shape[1:])
+
+        def run_stage(p_stored, xin):
+            p = param_prepare(p_stored) if param_prepare is not None else p_stored
+            return stage_fn(p, xin)
+
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        act_shape = (mb, *x_local.shape[1:])
+        fwd_carry = jnp.zeros(act_shape, x_local.dtype)
+        bwd_carry = jnp.zeros(act_shape, jnp.float32)
+        in_buf = jnp.zeros((W + 1, *act_shape), x_local.dtype)  # +scratch slot
+        d_stage = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), stage_local
+        )
+        d_head = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), head_params
+        )
+        dx_buf = jnp.zeros((n_micro, *act_shape), jnp.float32)
+        loss_acc = jnp.float32(0.0)
+
+        for t in range(T):  # static unroll: the schedule is compile-time
+            # ---- forward half-step: microbatch i_f = t - rank ----
+            i_f = t - rank
+            fwd_valid = jnp.logical_and(i_f >= 0, i_f < n_micro)
+            feed = micros[min(t, n_micro - 1)]  # rank 0 runs i_f == t (static)
+            inp = jnp.where(rank == 0, feed, fwd_carry)
+            y = run_stage(stage_local, inp)
+            # save the stage input for the recompute-backward; invalid
+            # windows write to the scratch slot W
+            slot = jnp.where(fwd_valid, jnp.clip(i_f, 0, n_micro - 1) % W, W)
+            in_buf = lax.dynamic_update_index_in_dim(in_buf, inp, slot, 0)
+
+            # ---- loss head (last stage; seeds its own same-step bwd).
+            # Only the last rank's result is used, and rank is a traced
+            # per-device value: lax.cond skips the (vocab-wide logits
+            # matmul + vjp) branch at runtime on every other rank ----
+            tgt = tgt_micros[jnp.clip(i_f, 0, n_micro - 1)]
+
+            def _head_run():
+                loss_t, head_vjp = jax.vjp(
+                    lambda hp, yy: loss_head(hp, yy, tgt), head_params, y
+                )
+                dhp_t, dy_head = head_vjp(jnp.float32(1.0))
+                return loss_t, dhp_t, dy_head
+
+            def _head_skip():
+                return (
+                    jnp.float32(0.0),
+                    jax.tree_util.tree_map(jnp.zeros_like, head_params),
+                    jnp.zeros_like(y),
+                )
+
+            loss_t, dhp_t, dy_head = lax.cond(rank == last, _head_run, _head_skip)
+            head_valid = jnp.logical_and(fwd_valid, rank == last)
+            loss_acc = loss_acc + jnp.where(head_valid, loss_t, 0.0)
+            d_head = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(head_valid, g, 0.0), d_head, dhp_t
+            )
+
+            # ---- backward half-step: microbatch i_b = t - 2(S-1) + rank --
+            i_b = t - 2 * (n_stages - 1) + rank
+            bwd_valid = jnp.logical_and(i_b >= 0, i_b < n_micro)
+            slot_b = jnp.where(bwd_valid, jnp.clip(i_b, 0, n_micro - 1) % W, W)
+            x_saved = lax.dynamic_index_in_dim(in_buf, slot_b, 0, keepdims=False)
+            dy = jnp.where(rank == last, dy_head.astype(jnp.float32), bwd_carry)
+            dy_seed = dy.astype(x_local.dtype)
+            _, stage_vjp = jax.vjp(run_stage, stage_local, x_saved)
+            dp_t, dx_t = stage_vjp(dy_seed)
+            d_stage = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(bwd_valid, g, 0.0), d_stage, dp_t
+            )
+            dx_t = dx_t.astype(jnp.float32)
+            if live_tp:
+                # Manual-tp transpose bookkeeping (verified numerically):
+                # inside the LOCAL vjp, jax transposes lax.psum to psum —
+                # so with a replicated seed, per-rank dx = (replicated
+                # residual paths)·g + tp·(rank-local weight paths)·g, and
+                # pmean over tp recovers the exact global cotangent
+                # (residual counted once, weight paths summed across
+                # ranks). Done per hop so the backward carry stays
+                # replicated-correct for the next stage. The same transpose
+                # inflates every stage-PARAM cotangent by tp (each param
+                # path crosses exactly one replicated-cotangent psum) —
+                # undone in finish_stage.
+                dx_t = lax.pmean(dx_t, tp_axis)
+            dx_keep = jnp.where(
+                jnp.logical_and(bwd_valid, rank == 0), dx_t, 0.0
+            )
+            dx_buf = dx_buf.at[jnp.clip(i_b, 0, n_micro - 1)].add(dx_keep)
+
+            # ---- carries: activations ride forward, cotangents backward --
+            fwd_carry = lax.ppermute(y, axis, fwd_perm)
+            bwd_carry = lax.ppermute(dx_t, axis, bwd_perm)
+
+        # ---- normalization + cross-device reductions ----
+        # loss_head returns a per-microbatch mean; the global loss is the
+        # mean over n_micro microbatches and n_data data shards. Every
+        # gradient divides by (n_micro * n_data) exactly once.
+        scale = 1.0 / (n_micro * n_data)
+        loss = lax.psum(loss_acc, axis) / n_micro  # only last rank added
+        for a in data_axes:
+            loss = lax.pmean(loss, a)
+
+        tp_fix = 1.0 / sizes[tp_axis] if live_tp else 1.0
+
+        def finish_stage(g, spec, p):
+            # tp_fix: the local-vjp psum transpose inflates stage-param
+            # cotangents by tp (see the dx_t comment); grad_sum_axes then
+            # psums replicated leaves so they sum ranks' true paths
+            g = g * (scale * tp_fix)
+            for a in grad_sum_axes(spec):
+                g = lax.psum(g, a)
+            # restore the leading stage dim so the global gradient pytree
+            # matches the (S, ...) storage layout the optimizer holds
+            return g.astype(p.dtype)[None]
+
+        d_stage = jax.tree_util.tree_map(
+            finish_stage, d_stage, param_specs, stage_local
+        )
+
+        def finish_head(g, p):
+            g = g * scale
+            for a in data_axes:
+                g = lax.psum(g, a)
+            g = lax.psum(g, axis)  # only the last stage contributed
+            return g.astype(p.dtype)
+
+        d_head = jax.tree_util.tree_map(finish_head, d_head, head_params)
+
+        dx = dx_buf.reshape(batch, *x_local.shape[1:]) * scale
+        dx = lax.psum(dx, axis)  # only rank 0 contributed; tp-correct already
+        return loss, d_stage, d_head, dx.astype(x_local.dtype)
+
+    x_spec = P(data_axes if data_axes else None)
+    head_rep_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
+    # stage grads come back in the (S, ...) storage layout and sharding
+    out_specs = (P(), param_specs, head_rep_specs, x_spec)
+    loss, d_stage, d_head, dx = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(param_specs, head_rep_specs, x_spec, x_spec),
+        out_specs=out_specs,
+        check_vma=False,
+    )(stage_params, head_params, x, targets)
+    return loss, d_stage, d_head, dx

