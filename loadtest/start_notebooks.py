@@ -115,9 +115,10 @@ def run_sim(args) -> None:
         chips_per_nb = 0
 
     teardown = []
+    watch_store = None
     if args.remote:
         try:
-            store, client = _remote_stack(
+            store, client, watch_store = _remote_stack(
                 cluster, Config(), teardown, qps=args.qps, burst=args.burst
             )
         except Exception:
@@ -133,7 +134,47 @@ def run_sim(args) -> None:
         client = cluster.client
     mgr.start()
     t0 = {}
+    admission_s = {}
+    phases = {}  # name -> {phase: t_since_create}
+
+    def observe(name: str, status: dict) -> bool:
+        """Update phase milestones (first-seen, relative to CR create) from a
+        status dict; True once the notebook is ready. Milestones: status
+        populated -> core reconciler processed the CR; pods Ready -> kubelet
+        ran every host; devices -> probe agents report chips; mesh_ready ->
+        the device-visibility readiness gate is green."""
+        now = time.monotonic() - t0[name]
+        ph = phases.setdefault(name, {})
+        # wire-shape (Go json tag) field names: this consumes raw API JSON
+        tpu = status.get("tpu") or {}
+        ready_replicas = status.get("readyReplicas", 0)
+        if (tpu or ready_replicas) and "reconciled" not in ph:
+            ph["reconciled"] = now
+        # only stamp pods_ready once the slice size is PUBLISHED (tpu.hosts)
+        # — defaulting to 1 would record multi-host slices ~N-1 pods early
+        hosts = tpu.get("hosts", 0) if args.accelerator else 1
+        if hosts and ready_replicas >= hosts and "pods_ready" not in ph:
+            ph["pods_ready"] = now
+        if args.accelerator and tpu.get("chipsVisible") and \
+                "devices_visible" not in ph:
+            ph["devices_visible"] = now
+        ready = tpu.get("meshReady", False) if args.accelerator \
+            else ready_replicas >= 1
+        if ready and "mesh_ready" not in ph:
+            ph["mesh_ready"] = now
+        return bool(ready)
+
+    watcher = None
     try:
+        if watch_store is not None:
+            # watch-driven readiness: the old tight polling loop issued ~25
+            # unthrottled GET sweeps per 100 ms against the same apiserver
+            # the manager talks to — the load GENERATOR was the biggest
+            # single consumer of server capacity. One watch stream is how
+            # kubectl wait does it, and costs the server one event fan-out.
+            watcher = watch_store.watch(
+                "kubeflow.org/v1beta1", "Notebook", namespace=args.namespace
+            )
         created = time.monotonic()
         for i in range(args.notebooks):
             name = f"{args.prefix}{i}"
@@ -141,30 +182,56 @@ def run_sim(args) -> None:
                 name, args.namespace, args.accelerator, args.topology, args.image,
                 pvc=not args.no_pvc,
             ):
-                t0[name] = time.monotonic()
+                t_call = time.monotonic()
+                t0[name] = t_call
                 client.create(default_scheme.decode(doc))
+                if doc["kind"] == "Notebook":
+                    # CREATE round-trip = apiserver + admission webhook chain
+                    admission_s[name] = time.monotonic() - t_call
         storm_s = time.monotonic() - created
 
         latencies = {}
         deadline = time.monotonic() + args.timeout
         pending = {f"{args.prefix}{i}" for i in range(args.notebooks)}
         while pending and time.monotonic() < deadline:
-            for name in list(pending):
-                nb = client.get(Notebook, args.namespace, name)
-                ready = (
-                    nb.status.tpu.mesh_ready
-                    if (args.accelerator and nb.status.tpu)
-                    else nb.status.ready_replicas >= 1
-                )
-                if ready:
-                    latencies[name] = time.monotonic() - t0[name]
+            if watcher is not None:
+                ev = watcher.get(timeout=0.25)
+                if ev is None:
+                    continue
+                name = ev.object.get("metadata", {}).get("name", "")
+                if name not in pending:
+                    continue
+                if observe(name, ev.object.get("status", {}) or {}):
+                    latencies[name] = phases[name]["mesh_ready"]
                     pending.discard(name)
-            time.sleep(0.005)
+            else:
+                for name in list(pending):
+                    nb = client.get(Notebook, args.namespace, name)
+                    if observe(name, nb.status.to_dict()):
+                        latencies[name] = phases[name]["mesh_ready"]
+                        pending.discard(name)
+                time.sleep(0.005)
     finally:
+        if watcher is not None:
+            watcher.stop()
         mgr.stop()
         for fn in reversed(teardown):
             fn()
         cluster.stop()
+
+    def p50(xs):
+        xs = [x for x in xs if x is not None]
+        return round(statistics.median(xs), 4) if xs else None
+
+    phase_p50 = {
+        "admission_s": p50(list(admission_s.values())),
+        "reconciled_s": p50([ph.get("reconciled") for ph in phases.values()]),
+        "pods_ready_s": p50([ph.get("pods_ready") for ph in phases.values()]),
+        "devices_visible_s": p50(
+            [ph.get("devices_visible") for ph in phases.values()]
+        ),
+        "mesh_ready_s": p50([ph.get("mesh_ready") for ph in phases.values()]),
+    }
 
     vals = sorted(latencies.values())
     result = {
@@ -181,6 +248,11 @@ def run_sim(args) -> None:
             else None
         ),
         "ready_max_s": round(vals[-1], 4) if vals else None,
+        # per-phase p50s (first-seen relative to CR create): where the
+        # latency actually goes — admission round-trip, core reconcile (STS
+        # up), kubelet (pods Ready), probe agents (devices visible), and
+        # the device-visibility readiness gate
+        "phase_p50": phase_p50,
     }
     if args.remote and getattr(store, "throttle", None) is not None:
         # client-side QPS/burst limiter (cluster/remote.py _TokenBucket):
@@ -209,7 +281,7 @@ def _remote_stack(cluster, config, teardown, qps=100.0, burst=200):
     # unthrottled client so the driver's polling doesn't eat the manager's
     # QPS budget (two clients = two rate limiters, as in a real cluster)
     poller = RemoteStore(api.base_url, token="loadtest", ca_file=store.ca_file, qps=0)
-    return store, Client(poller)
+    return store, Client(poller), poller
 
 
 def main() -> None:

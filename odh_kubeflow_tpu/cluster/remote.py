@@ -98,6 +98,99 @@ def _unlink_all(paths: List[str]) -> None:
             pass
 
 
+def _tcp_nodelay(conn) -> None:
+    """Disable Nagle on a (connected) http.client connection: paired with
+    delayed ACKs it costs ~40ms per request on kept-alive sockets."""
+    sock = getattr(conn, "sock", None)
+    if sock is not None:
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+
+
+class HostPool:
+    """Per-thread keep-alive HTTP(S) connection to one host — the shared
+    pooled transport for RemoteStore and the webhook dispatcher (a fresh
+    TCP + TLS handshake per request costs more than most requests).
+
+    Retry discipline for stale keep-alive sockets, chosen so a request the
+    server may have EXECUTED is never silently re-sent:
+    - send-phase failure (conn.request raises): the server never parsed the
+      request on this connection — safe to retry once for any method;
+    - response-phase failure: retry once for idempotent GETs only;
+    - timeouts NEVER retry — the server may still be executing the call
+      (a re-sent POST would double-create; the caller sees the timeout).
+    """
+
+    def __init__(self, scheme: str, host: str, port, timeout: float, context=None):
+        self.scheme = scheme
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.context = context
+        self._local = threading.local()
+
+    def _conn(self):
+        import http.client
+
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            if self.scheme == "https":
+                conn = http.client.HTTPSConnection(
+                    self.host, self.port, timeout=self.timeout, context=self.context
+                )
+            else:
+                conn = http.client.HTTPConnection(
+                    self.host, self.port, timeout=self.timeout
+                )
+            conn.connect()
+            _tcp_nodelay(conn)  # request writes must not wait on delayed ACKs
+            self._local.conn = conn
+        return conn
+
+    def drop(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        self._local.conn = None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def request(self, method: str, path: str, body, headers) -> Tuple[int, bytes]:
+        import http.client
+
+        retryable = (http.client.HTTPException, ConnectionError, OSError)
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                conn.request(method, path, body=body, headers=headers)
+            except socket.timeout:
+                self.drop()
+                raise
+            except retryable:
+                # send phase: the request never reached the server's parser
+                # on this (stale) connection
+                self.drop()
+                if attempt:
+                    raise
+                continue
+            try:
+                resp = conn.getresponse()
+                data = resp.read()  # drain fully so the conn is reusable
+            except socket.timeout:
+                self.drop()
+                raise
+            except retryable:
+                self.drop()
+                if attempt or method != "GET":
+                    raise  # the server may have executed a non-idempotent call
+                continue
+            return resp.status, data
+        raise ConnectionError("unreachable")  # pragma: no cover
+
+
 class _TokenBucket:
     """Client-side API throttling — the client-go rate.Limiter the reference
     wires through --kube-api-qps/--kube-api-burst
@@ -537,14 +630,31 @@ class RemoteStore:
         except urllib.error.HTTPError as e:
             raise _error_from_response(e.code, e.read()) from None
 
+    def _pool(self) -> HostPool:
+        """Keep-alive pooled transport (HostPool). Watch streams
+        deliberately do NOT use the pool: they hold their connection open
+        for the stream's lifetime (_open)."""
+        pool = getattr(self, "_host_pool", None)
+        if pool is None:
+            from urllib.parse import urlsplit
+
+            u = urlsplit(self.base_url)
+            pool = self._host_pool = HostPool(
+                u.scheme, u.hostname, u.port, self.timeout, context=self._ssl_ctx
+            )
+        return pool
+
     def _request(self, path: str, method: str = "GET",
                  body: Optional[Dict[str, Any]] = None,
                  content_type: str = "application/json") -> Dict[str, Any]:
         payload = json.dumps(body).encode() if body is not None else None
-        resp = self._open(path, method, payload, content_type if payload else None,
-                          timeout=self.timeout)
-        with resp:
-            return json.loads(resp.read())
+        if self.throttle is not None:
+            self.throttle.acquire()
+        headers = self._headers(content_type if payload else None)
+        status, data = self._pool().request(method, path, payload, headers)
+        if status >= 400:
+            raise _error_from_response(status, data)
+        return json.loads(data) if data else {}
 
     def _mapping(self, api_version: str, kind: str):
         return self.mapper.mapping_for(api_version, kind)

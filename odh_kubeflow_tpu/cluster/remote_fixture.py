@@ -91,8 +91,18 @@ def build_remote_stack(
 
     webhook_server = WebhookServer(certfile=crt, keyfile=key).start()
     teardown.append(webhook_server.stop)
+    # The webhook gets its OWN client, like the reference's separate webhook
+    # manager process with its own client-go instance: admission latency
+    # must not queue behind the reconcilers' rate-limiter bucket (a create
+    # storm drains the manager's QPS budget exactly when admission runs).
+    # qps=0: admission latency rides the caller's request; the webhook's
+    # 2-3 reads per review must not queue on a client-side rate limiter
+    # (the default 20/30 bucket added ~100ms per read under a storm)
+    webhook_remote = RemoteStore(
+        api.base_url, token=token, ca_file=ca, timeout=30, qps=0
+    )
     webhook_server.register(
-        "/mutate-notebook-v1", NotebookWebhook(Client(remote), config).handle
+        "/mutate-notebook-v1", NotebookWebhook(Client(webhook_remote), config).handle
     )
     cfg = MutatingWebhookConfiguration()
     cfg.metadata.name = "notebook-mutator"

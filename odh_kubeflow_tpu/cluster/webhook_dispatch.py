@@ -18,6 +18,7 @@ import base64
 import json
 import logging
 import ssl
+import threading
 import urllib.request
 from typing import Any, Dict, Optional
 
@@ -44,6 +45,34 @@ class WebhookDispatcher:
         self.mapper = RESTMapper()
         self.mapper.populate_from_scheme(scheme)
         self._ssl_cache: Dict[str, ssl.SSLContext] = {}
+        # keep-alive pooled transports by webhook host (cluster/remote.py
+        # HostPool — per-thread connections, safe stale-conn retry):
+        # admission sits on every matching CREATE/UPDATE, so a fresh TLS
+        # handshake per callout would tax exactly the hot path
+        # (kube-apiserver pools its webhook transports the same way)
+        self._pools: Dict[tuple, Any] = {}
+        self._pools_lock = threading.Lock()
+
+    def _post_pooled(self, url: str, payload: bytes, ctx, timeout: float) -> dict:
+        from urllib.parse import urlsplit
+
+        from .remote import HostPool
+
+        u = urlsplit(url)
+        key = (u.scheme, u.hostname, u.port, id(ctx))
+        with self._pools_lock:
+            pool = self._pools.get(key)
+            if pool is None:
+                pool = self._pools[key] = HostPool(
+                    u.scheme, u.hostname, u.port, timeout, context=ctx
+                )
+        status, data = pool.request(
+            "POST", u.path or "/", payload,
+            {"Content-Type": "application/json"},
+        )
+        if status >= 400:
+            raise ConnectionError(f"webhook POST {url} -> {status}")
+        return json.loads(data)
 
     # -- ApiServer admission hook --
 
@@ -144,15 +173,8 @@ class WebhookDispatcher:
             },
         }
         try:
-            req = urllib.request.Request(
-                url,
-                data=json.dumps(review).encode(),
-                headers={"Content-Type": "application/json"},
-                method="POST",
-            )
             ctx = self._ssl_context(client_config.get("caBundle", ""))
-            with urllib.request.urlopen(req, timeout=timeout, context=ctx) as resp:
-                body = json.loads(resp.read())
+            body = self._post_pooled(url, json.dumps(review).encode(), ctx, timeout)
         except AdmissionDeniedError:
             raise
         except Exception as e:

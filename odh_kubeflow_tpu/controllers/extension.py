@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import logging
+import time
 from typing import List, Optional
 
 from ..api.core import ConfigMap, Secret, Service, ServiceAccount, ServicePort
@@ -52,6 +53,7 @@ from ..apimachinery import (
     AlreadyExistsError,
     LabelSelector,
     NotFoundError,
+    parse_time,
     sanitize_name,
 )
 from ..cluster.client import retry_on_conflict
@@ -258,7 +260,13 @@ class TPUWorkbenchReconciler:
                 parts.append(pem.strip())
         if not parts:
             # all CA sources gone: prune the stale bundle (reference
-            # UnsetNotebookCertConfig :639-704 analog), don't freeze it
+            # UnsetNotebookCertConfig :639-704 analog), don't freeze it.
+            # Cached existence pre-check: no CA sources AND no bundle (the
+            # common bare-cluster case) must not cost a DELETE per reconcile.
+            try:
+                self.client.get(ConfigMap, nb.metadata.namespace, CA_BUNDLE_CONFIGMAP)
+            except NotFoundError:
+                return
             try:
                 self.client.delete(
                     ConfigMap, nb.metadata.namespace, CA_BUNDLE_CONFIGMAP
@@ -267,6 +275,15 @@ class TPUWorkbenchReconciler:
                 pass
             return
         desired_data = {"ca-bundle.crt": "\n".join(parts) + "\n"}
+
+        # cached no-op pre-check: bundle already equal -> zero API requests
+        try:
+            if self.client.get(
+                ConfigMap, nb.metadata.namespace, CA_BUNDLE_CONFIGMAP
+            ).data == desired_data:
+                return
+        except NotFoundError:
+            pass
 
         def attempt():
             # shared per-namespace object, multiple concurrent reconcilers:
@@ -534,7 +551,50 @@ class TPUWorkbenchReconciler:
     def cleanup_auth_objects(self, nb: Notebook) -> None:
         """Auth switched off: revoke the delegator binding and remove the
         orphan proxy Service/ConfigMap (the SA stays — it's the pod identity).
-        Leaving the ClusterRoleBinding would keep tokenreview rights forever."""
+        Leaving the ClusterRoleBinding would keep tokenreview rights forever.
+
+        Gated on the CACHED proxy Service/ConfigMap: for the (default)
+        never-auth notebook this is a pure no-op and must not cost four
+        blind DELETEs per reconcile. When either cached marker exists the
+        full sweep runs — including the (unwatched, cluster-scoped)
+        ClusterRoleBinding, which is why the markers are the WATCHED kinds.
+        Because a marker can disappear while the CRB survives (a partially
+        failed earlier sweep), the FIRST reconcile of each notebook per
+        manager lifetime always runs the full sweep — leaked bindings are
+        reaped at the next manager start or notebook event, without paying
+        per-reconcile cluster-scoped reads."""
+        swept = getattr(self, "_auth_swept", None)
+        if swept is None:
+            swept = self._auth_swept = set()
+            self._sweep_epoch = time.time()
+        key = (nb.metadata.namespace, nb.metadata.name, nb.metadata.uid)
+        first_sweep = key not in swept
+        swept.add(key)
+        if first_sweep:
+            # only PRE-EXISTING notebooks can carry leftovers from a
+            # previous manager's partial sweep; ones created under this
+            # manager skip straight to the marker gate (a startup sweep for
+            # every fresh create would land exactly during create storms)
+            try:
+                created = parse_time(nb.metadata.creation_timestamp).timestamp()
+                first_sweep = created < self._sweep_epoch
+            except (ValueError, TypeError):
+                pass
+        marker_present = first_sweep
+        if not marker_present:
+            for cls, ns, name in (
+                (Service, nb.metadata.namespace, auth_service_name(nb.metadata.name)),
+                (ConfigMap, nb.metadata.namespace,
+                 f"{nb.metadata.name}-kube-rbac-proxy-config"),
+            ):
+                try:
+                    self.client.get(cls, ns, name)
+                    marker_present = True
+                    break
+                except NotFoundError:
+                    pass
+        if not marker_present:
+            return
         for cls, ns, name in (
             (ClusterRoleBinding, "", auth_binding_name(nb)),
             (Service, nb.metadata.namespace, auth_service_name(nb.metadata.name)),
@@ -640,7 +700,14 @@ def sync_runtime_images(client, config, namespace: str) -> bool:
             data[key] = json.dumps(meta, sort_keys=True)
     if not data:
         # last runtime-image source removed: prune the per-ns catalog so
-        # notebooks stop offering images that no longer exist
+        # notebooks stop offering images that no longer exist. Cached
+        # existence pre-check: with no runtime images configured at all
+        # (the common case) this is a no-op and must not DELETE per
+        # reconcile.
+        try:
+            client.get(ConfigMap, namespace, RUNTIME_IMAGES_CONFIGMAP)
+        except NotFoundError:
+            return False
         try:
             client.delete(ConfigMap, namespace, RUNTIME_IMAGES_CONFIGMAP)
         except NotFoundError:

@@ -137,9 +137,16 @@ class InformerRegistry:
         """The informer for (api_version, kind) iff it already exists AND
         has synced — never creates or starts one. The read-path lookup for
         CachedClient: cache-backed reads must not implicitly spin up
-        watches for kinds no controller asked to watch."""
-        with self._lock:
-            inf = self._informers.get((api_version, kind))
+        watches for kinds no controller asked to watch.
+
+        Deliberately LOCK-FREE (GIL-atomic dict read): peek is called from
+        the in-process admission chain, which runs UNDER the Store lock
+        (store.update_raw -> webhook handler -> cached read), while
+        informer_for holds this registry's lock when it calls store.watch
+        (needs the Store lock) — taking the registry lock here closes an
+        ABBA deadlock cycle. A racing registration at worst returns None,
+        and the caller falls through to a direct read."""
+        inf = self._informers.get((api_version, kind))
         if inf is None or not inf.synced.is_set():
             return None
         return inf

@@ -70,9 +70,19 @@ def tpu_like(devices=None) -> bool:
         kind = str(getattr(d, "device_kind", "") or "").lower()
         if "tpu" in plat or "tpu" in kind:
             return True
-        if plat in ("gpu", "cuda", "rocm") or "gpu" in kind or "nvidia" in kind:
+        if (
+            plat in ("gpu", "cuda", "rocm", "metal", "vulkan", "oneapi")
+            or "gpu" in kind
+            or "nvidia" in kind
+            or "amd" in kind
+        ):
             continue  # a GPU is non-CPU but NOT pallas-TPU-lowerable
-        # Unknown non-CPU platform (axon and successors): this environment's
-        # only accelerator access path is the TPU tunnel — treat as TPU.
+        # Unknown non-CPU platform (axon and successors): treat as TPU.
+        # This deliberately FAILS OPEN — in this deployment the only
+        # accelerator access path is a (renamed) TPU dispatch platform, and
+        # the two failure modes are asymmetric: guessing TPU on a future
+        # non-TPU plugin breaks loudly at pallas lowering, while guessing
+        # non-TPU on a renamed TPU platform silently forfeits every kernel
+        # (exactly how round 3 lost its benchmark evidence).
         return True
     return False
