@@ -569,7 +569,6 @@ class TPUWorkbenchReconciler:
             self._sweep_epoch = time.time()
         key = (nb.metadata.namespace, nb.metadata.name, nb.metadata.uid)
         first_sweep = key not in swept
-        swept.add(key)
         if first_sweep:
             # only PRE-EXISTING notebooks can carry leftovers from a
             # previous manager's partial sweep; ones created under this
@@ -594,6 +593,7 @@ class TPUWorkbenchReconciler:
                 except NotFoundError:
                     pass
         if not marker_present:
+            swept.add(key)
             return
         for cls, ns, name in (
             (ClusterRoleBinding, "", auth_binding_name(nb)),
@@ -605,6 +605,11 @@ class TPUWorkbenchReconciler:
                 self.client.delete(cls, ns, name)
             except NotFoundError:
                 pass
+        # only a COMPLETED sweep retires the one-shot: a transient delete
+        # failure above raises out of reconcile, and the requeue re-enters
+        # with first_sweep still true (else a leaked CRB would survive the
+        # manager's whole lifetime)
+        swept.add(key)
 
     # ================= the lock =================
 

@@ -27,8 +27,9 @@ import time
 from typing import List, Optional, Tuple
 
 from ..api.core import Pod
-from ..api.notebook import Notebook
+from ..api.notebook import Notebook, TPUStatus
 from ..apimachinery import NotFoundError, now_rfc3339, parse_time
+from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import plan_slice
@@ -155,7 +156,7 @@ class ProbeStatusController:
         newly_ready = mesh_ready and not (
             nb.status.tpu and nb.status.tpu.first_ready_time
         )
-        self._write(nb, chips_visible, mesh_ready, newly_ready)
+        newly_ready = self._write(nb, chips_visible, mesh_ready, newly_ready)
         if newly_ready:
             # observe only after the write persisted (double-count guard)
             try:
@@ -177,12 +178,14 @@ class ProbeStatusController:
 
     def _write(
         self, nb: Notebook, chips_visible: int, mesh_ready: bool, newly_ready: bool
-    ) -> None:
+    ) -> bool:
+        """Publish the device-gate fields; returns whether first_ready_time
+        was set by THIS call (the metric-observe gate)."""
         # no-op pre-check against the (cache-served) object in hand: steady-
         # state heartbeat cycles then cost only the probe HTTP GETs, not an
-        # uncached API read-modify-write per notebook per cycle. A stale
-        # cache that hides a needed write self-heals: the event that updates
-        # the cache re-enqueues this notebook (level-triggered).
+        # API write per notebook per cycle. A stale cache that hides a
+        # needed write self-heals: the event that updates the cache
+        # re-enqueues this notebook (level-triggered).
         tpu = nb.status.tpu
         if (
             tpu is not None
@@ -190,17 +193,48 @@ class ProbeStatusController:
             and tpu.mesh_ready == mesh_ready
             and not (newly_ready and not tpu.first_ready_time)
         ):
-            return
+            return False
 
-        # merge-PATCH of the device-gate fields only (disjoint ownership
-        # with the core reconciler's mirror — see notebook.py
-        # _update_status): one request, no RMW loop, no conflict retries
-        patch = {"chipsVisible": int(chips_visible), "meshReady": bool(mesh_ready)}
-        if newly_ready:
-            patch["firstReadyTime"] = now_rfc3339()
-        try:
-            self.client.patch_status(
-                Notebook, nb.metadata.namespace, nb.metadata.name, {"tpu": patch}
+        if not newly_ready:
+            # common path: merge-PATCH of the device-gate fields only
+            # (disjoint ownership with the core reconciler's mirror — see
+            # notebook.py _update_status): one request, no RMW loop
+            try:
+                self.client.patch_status(
+                    Notebook, nb.metadata.namespace, nb.metadata.name,
+                    {"tpu": {"chipsVisible": int(chips_visible),
+                             "meshReady": bool(mesh_ready)}},
+                )
+            except NotFoundError:
+                pass  # deleted mid-reconcile
+            return False
+
+        # first-ready transition (once per notebook lifetime): the anchor
+        # field is SET-ONCE, and the cached nb may lag our own earlier
+        # write — decide on a FRESH read under conflict retry so a racing
+        # reconcile can neither move the anchor nor double-observe the
+        # slice-ready metric
+        def attempt() -> bool:
+            cur = self.api_reader.get(
+                Notebook, nb.metadata.namespace, nb.metadata.name
             )
+            tpu = cur.status.tpu or TPUStatus()
+            first = not tpu.first_ready_time
+            changed = (
+                first
+                or tpu.chips_visible != chips_visible
+                or tpu.mesh_ready != mesh_ready
+            )
+            tpu.chips_visible = chips_visible
+            tpu.mesh_ready = mesh_ready
+            if first:
+                tpu.first_ready_time = now_rfc3339()
+            if changed:
+                cur.status.tpu = tpu
+                self.client.update_status(cur)
+            return first
+
+        try:
+            return retry_on_conflict(attempt)
         except NotFoundError:
-            pass  # deleted mid-reconcile
+            return False  # deleted mid-reconcile

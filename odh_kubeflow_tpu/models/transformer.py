@@ -43,6 +43,15 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     rope_theta: float = 10000.0
     remat: bool = True
+    # What the layer checkpoint SAVES (only meaningful with remat=True):
+    #   ""     — save nothing: minimum memory, recompute everything (incl.
+    #            the flash forward kernel) during backward,
+    #   "dots" — save matmul outputs without batch dims (XLA's standard
+    #            selective-remat sweet spot: HBM for avoided FLOPs),
+    #   "attn" — save ONLY the attention outputs (checkpoint_name'd): the
+    #            single most expensive recompute in the layer, at a fraction
+    #            of "dots"'s memory.
+    remat_policy: str = ""
     use_flash: bool = True
     seq_axis: str = ""  # set to "sp" to run ring attention over that mesh axis
     # Mixture-of-Experts: set to swap every layer's FFN for routed experts
@@ -199,6 +208,18 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
     return mha_reference(q, k, v, causal=True)
 
 
+def _remat_policy(cfg: TransformerConfig):
+    """Map cfg.remat_policy to a jax.checkpoint policy (None = save
+    nothing)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if cfg.remat_policy:
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
+    return None
+
+
 def _constrainer(cfg: TransformerConfig, mesh):
     def constrain(y, axes):
         if mesh is None:
@@ -285,6 +306,9 @@ def _layer(x, layer_params, positions, cfg: TransformerConfig, mesh=None,
     constrain = _constrainer(cfg, mesh)
     q, k, v = layer_qkv(x, layer_params, positions, cfg)
     attn = _attention(q, k, v, cfg, mesh)
+    from jax.ad_checkpoint import checkpoint_name
+
+    attn = checkpoint_name(attn, "attn_out")  # remat_policy="attn" saves these
     attn = constrain(attn, ("batch", "seq", "heads", "head_dim"))
     return layer_post_attention(x, attn, layer_params, cfg, mesh, ep_axis=ep_axis,
                                 tp_axis=tp_axis)
@@ -321,7 +345,7 @@ def forward(
 
     body = partial(_layer, positions=positions, cfg=cfg, mesh=mesh)
     if cfg.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(cfg))
 
     x, auxes = lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"])

@@ -225,3 +225,38 @@ def test_gqa_indivisible_fused_axis_replicates():
     jax.tree_util.tree_map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     )
+
+
+def test_remat_policies_preserve_loss_and_grads():
+    """remat_policy changes WHAT the layer checkpoint saves, never the math:
+    loss and gradients identical across "", "dots", "attn" (and remat off)."""
+    from dataclasses import replace
+
+    import numpy as np
+
+    base = TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+        dtype=jnp.float32, use_flash=False, remat=True,
+    )
+    params = init_params(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, base.vocab)
+
+    def lg(cfg):
+        return jax.value_and_grad(loss_fn)(params, {"tokens": tokens}, cfg)
+
+    ref_loss, ref_g = lg(replace(base, remat=False))
+    for policy in ("", "dots", "attn"):
+        loss, g = lg(replace(base, remat_policy=policy))
+        assert np.allclose(float(loss), float(ref_loss), atol=1e-6), policy
+        for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g)[0],
+            jax.tree_util.tree_flatten_with_path(ref_g)[0],
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5,
+                err_msg=f"{policy} {jax.tree_util.keystr(pa)}",
+            )
+    import pytest
+
+    with pytest.raises(ValueError):
+        loss_fn(params, {"tokens": tokens}, replace(base, remat_policy="bogus"))
