@@ -433,3 +433,57 @@ def test_wire_fixture_debug_escapes(tmp_path, monkeypatch):
     finally:
         for fn in reversed(teardown):
             fn()
+
+
+def test_host_pool_stale_connection_retry_and_post_semantics():
+    """HostPool (keep-alive transport): a connection the server closed
+    between requests is retried transparently for any method's SEND-phase
+    failure; a response-phase failure after a non-GET is NOT retried (the
+    server may have executed the call)."""
+    import http.server
+    import threading
+
+    from odh_kubeflow_tpu.cluster.remote import HostPool
+    from odh_kubeflow_tpu.utils.httpserve import ThreadedHTTPServer, serve_in_thread, shutdown
+
+    hits = []
+
+    class OneShot(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def _serve(self):
+            hits.append(self.command)
+            body = b'{"ok": true}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            # server closes after EVERY response: each subsequent request on
+            # the pooled connection hits a stale socket at send time
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.wfile.write(body)
+            self.close_connection = True
+
+        do_GET = do_POST = _serve
+
+    httpd = ThreadedHTTPServer(("127.0.0.1", 0), OneShot)
+    thread = serve_in_thread(httpd, "oneshot")
+    host, port = httpd.server_address[:2]
+    try:
+        pool = HostPool("http", host, port, timeout=5)
+        # first request: fresh connection
+        status, data = pool.request("GET", "/a", None, {})
+        assert status == 200
+        # second request: the pooled socket is dead (server sent
+        # Connection: close) -> send-phase failure -> transparent retry on a
+        # fresh connection, for GET and POST alike
+        status, _ = pool.request("GET", "/b", None, {})
+        assert status == 200
+        status, _ = pool.request("POST", "/c", b"{}", {"Content-Type": "application/json"})
+        assert status == 200
+        assert hits == ["GET", "GET", "POST"]  # every request reached the server ONCE
+    finally:
+        shutdown(httpd)
