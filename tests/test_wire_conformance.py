@@ -366,3 +366,27 @@ def test_client_side_throttle_blocks_excess_requests(golden):
     assert elapsed >= 0.05, f"burst never throttled ({elapsed:.3f}s)"
     assert remote.throttle.waits >= n - 2 - 1
     server.assert_complete()
+
+
+def test_status_subresource_merge_patch_path(golden):
+    """The status writers' merge-PATCH lands on the STATUS SUBRESOURCE path
+    with the merge-patch content type — exactly what kube-apiserver expects
+    (a PATCH to the main resource would run admission and touch spec)."""
+    server = golden([
+        Exchange(
+            "PATCH", f"{NB_PATH}/demo/status",
+            content_type="application/merge-patch+json",
+            body=golden_notebook(rv="43820"),
+            request_check=lambda body: ("status" in body and "tpu" in body["status"])
+            or (_ for _ in ()).throw(AssertionError(f"bad patch body {body}")),
+        ),
+    ])
+    from odh_kubeflow_tpu.cluster.client import Client
+    from odh_kubeflow_tpu.api.notebook import Notebook
+
+    client = Client(_store(server))
+    client.patch_status(
+        Notebook, "default", "demo",
+        {"tpu": {"chipsVisible": 4, "meshReady": True}},
+    )
+    server.assert_complete()
