@@ -6,7 +6,7 @@ exercised, benchmarked, and utilization-probed out of the box.
 """
 from .checkpoint import latest_step, restore_train_state, save_train_state
 from .decode import KVCache, decode_step, generate, init_cache, prefill
-from .moe import MoEConfig, moe_ffn, route_topk
+from .moe import MoEConfig, moe_ffn, route_indices, route_topk
 from .transformer import (
     TransformerConfig,
     forward,
@@ -15,6 +15,7 @@ from .transformer import (
     make_pp_train_step,
     make_train_step,
     param_specs,
+    pp_1f1b_value_and_grad,
     pp_forward,
     pp_loss_fn,
     pp_param_specs,
@@ -24,6 +25,7 @@ from .transformer import (
 __all__ = [
     "KVCache",
     "MoEConfig",
+    "route_indices",
     "decode_step",
     "generate",
     "init_cache",
@@ -38,6 +40,7 @@ __all__ = [
     "make_pp_train_step",
     "make_train_step",
     "param_specs",
+    "pp_1f1b_value_and_grad",
     "pp_forward",
     "pp_loss_fn",
     "pp_param_specs",

@@ -10,7 +10,7 @@ webhook injects (tpu/env.py) turns into a live ICI mesh with one call:
     mesh = MeshPlan.auto(len(jax.devices())).build()
 """
 from .distributed import initialize_from_env, slice_mesh_axes
-from .pipeline import pipeline_apply, stack_stages
+from .pipeline import pipeline_apply, pipeline_value_and_grad_1f1b, stack_stages
 from .mesh import (
     AXES,
     MeshPlan,
@@ -22,6 +22,7 @@ from .mesh import (
 __all__ = [
     "AXES",
     "pipeline_apply",
+    "pipeline_value_and_grad_1f1b",
     "stack_stages",
     "MeshPlan",
     "batch_spec",
