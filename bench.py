@@ -213,6 +213,11 @@ def bench_train_step():
         make_train_step,
     )
 
+    import os
+
+    # A/B knob for the remat policy without code edits (VERDICT r4 #9):
+    # "" = save nothing, "dots" = matmul outputs, "attn" = attention outputs
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "")
     cfg = TransformerConfig(
         vocab=32768,
         d_model=1024,
@@ -223,6 +228,7 @@ def bench_train_step():
         dtype=jnp.bfloat16,
         use_flash=True,
         remat=True,
+        remat_policy=remat_policy,
     )
     batch, seq = 8, 2048
     params = init_params(jax.random.PRNGKey(0), cfg)
@@ -263,6 +269,7 @@ def bench_train_step():
         "batch": batch,
         "seq": seq,
         "mfu_est": round(mfu, 3),
+        "remat_policy": remat_policy or "none-saved",
         "final_loss": round(float(loss), 3),
     }
 
