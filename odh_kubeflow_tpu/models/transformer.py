@@ -433,8 +433,15 @@ def _make_param_prepare(gather_axes):
     return param_prepare
 
 
+def _offset_axes(gather_axes, by: int):
+    """Shift gather axis indices (the interleaved layout carries a leading
+    chunk dim before the per-layer stack)."""
+    return {k: v + by for k, v in gather_axes.items()}
+
+
 def pp_forward(
-    params, tokens, cfg: TransformerConfig, mesh, n_micro: int = 4, with_aux=False
+    params, tokens, cfg: TransformerConfig, mesh, n_micro: int = 4, with_aux=False,
+    n_chunks: int = 1,
 ):
     """Pipeline-parallel forward. `params["layers"]` must be STAGE-STACKED:
     (S, L/S, ...) leaves, S == mesh["pp"], sharded per pp_param_specs (see
@@ -484,12 +491,17 @@ def pp_forward(
         h, auxes = lax.scan(scan_fn, h, stage_layers)
         return h, jnp.sum(auxes)
 
-    param_prepare = _make_param_prepare(gather_axes)
-    param_specs_ = pp_param_specs(cfg, mesh, sizes.get("pp", 1))["layers"]
+    param_prepare = _make_param_prepare(
+        _offset_axes(gather_axes, 1) if n_chunks > 1 else gather_axes
+    )
+    param_specs_ = pp_param_specs(
+        cfg, mesh, sizes.get("pp", 1), n_chunks=n_chunks
+    )["layers"]
     x, aux = pipeline_apply(
         stage_fn, params["layers"], x, mesh, n_micro=n_micro,
         with_aux=True, param_specs=param_specs_,
         param_prepare=param_prepare if gather_axes else None,
+        n_chunks=n_chunks,
     )
     x = rms_norm(x, params["final_norm"])
     logits = jnp.einsum(
@@ -500,10 +512,12 @@ def pp_forward(
     return logits
 
 
-def pp_loss_fn(params, batch, cfg: TransformerConfig, mesh, n_micro: int = 4):
+def pp_loss_fn(params, batch, cfg: TransformerConfig, mesh, n_micro: int = 4,
+               n_chunks: int = 1):
     tokens = batch["tokens"]
     logits, aux = pp_forward(
-        params, tokens, cfg, mesh, n_micro=n_micro, with_aux=True
+        params, tokens, cfg, mesh, n_micro=n_micro, with_aux=True,
+        n_chunks=n_chunks,
     )
     logits, targets = logits[:, :-1], tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -578,7 +592,8 @@ def pp_1f1b_value_and_grad(params, batch, cfg: TransformerConfig, mesh,
 
 
 def make_pp_train_step(cfg: TransformerConfig, mesh, n_micro: int = 4,
-                       optimizer=None, schedule: str = "gpipe"):
+                       optimizer=None, schedule: str = "gpipe",
+                       n_chunks: int = 1):
     """Pipeline-parallel train step. schedule="gpipe": autodiff through the
     fill/drain pipeline (O(n_micro) activation memory; aux/MoE supported).
     schedule="1f1b": interleaved forward/backward with O(stages) activation
@@ -590,13 +605,15 @@ def make_pp_train_step(cfg: TransformerConfig, mesh, n_micro: int = 4,
     )
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
+    if n_chunks > 1 and schedule != "gpipe":
+        raise ValueError("interleaved chunks compose with the gpipe schedule")
 
     def step(params, opt_state, batch):
         if schedule == "1f1b":
             loss, grads = pp_1f1b_value_and_grad(params, batch, cfg, mesh, n_micro)
         else:
             loss, grads = jax.value_and_grad(pp_loss_fn)(
-                params, batch, cfg, mesh, n_micro
+                params, batch, cfg, mesh, n_micro, n_chunks
             )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
@@ -621,7 +638,8 @@ def _interleave_wqkv(wqkv, h: int, kv: int, tp: int):
     )
 
 
-def to_pp_params(params, n_stages: int, cfg: TransformerConfig = None, mesh=None):
+def to_pp_params(params, n_stages: int, cfg: TransformerConfig = None, mesh=None,
+                 n_chunks: int = 1):
     """(L, ...)-stacked params -> the pipeline storage layout ((S, L/S, ...)
     layers; everything else unchanged). With cfg+mesh given, also applies
     the wqkv head interleave required by manual-tp stages
@@ -641,11 +659,12 @@ def to_pp_params(params, n_stages: int, cfg: TransformerConfig = None, mesh=None
             }
     return {
         **{k: v for k, v in params.items() if k != "layers"},
-        "layers": stack_stages(layers, n_stages),
+        "layers": stack_stages(layers, n_stages, n_chunks=n_chunks),
     }
 
 
-def pp_param_specs(cfg: TransformerConfig, mesh, n_stages: int):
+def pp_param_specs(cfg: TransformerConfig, mesh, n_stages: int,
+                   n_chunks: int = 1):
     """param_specs variant for pipeline training: per-layer params carry a
     leading stage dim sharded over pp ((S, L/S, ...) layout, see
     parallel/pipeline.stack_stages). Within a stage (VERDICT r3 weak #2):
@@ -683,8 +702,12 @@ def pp_param_specs(cfg: TransformerConfig, mesh, n_stages: int):
     def add_stage(name, spec):
         del spec
         if cfg.moe is not None and name in ("we_gate", "we_up", "we_out"):
-            return PartitionSpec("pp", None, "ep")
-        return manual.get(name, PartitionSpec("pp"))
+            out = PartitionSpec("pp", None, "ep")
+        else:
+            out = manual.get(name, PartitionSpec("pp"))
+        if n_chunks > 1:  # interleaved layout: leading chunk dim after pp
+            out = PartitionSpec(out[0], None, *out[1:])
+        return out
 
     return {
         **{k: v for k, v in base.items() if k != "layers"},

@@ -46,8 +46,17 @@ def pipeline_apply(
     with_aux: bool = False,
     param_specs: Any = None,
     param_prepare: Optional[Callable[[Any], Any]] = None,
+    n_chunks: int = 1,
 ):
     """Run stage-stacked parameters as a microbatched pipeline.
+
+    n_chunks > 1 selects the INTERLEAVED (virtual-stage) schedule: each rank
+    holds v = n_chunks non-adjacent layer chunks (stack_stages layout
+    (S, v, L/(S*v), ...)), the pipeline runs S*v virtual stages over the
+    same single ppermute ring, and the fill/drain bubble shrinks by v —
+    efficiency (m*v)/(m*v + S - 1) in small-step units vs m/(m + S - 1).
+    Requires n_micro % n_stages == 0 (the schedule injects microbatches in
+    groups of S, as Megatron's interleaved schedule does).
 
     stage_fn(params_one_stage, x_micro) -> y_micro (same shape as x_micro),
     or (y_micro, aux_scalar) when with_aux=True;
@@ -69,12 +78,27 @@ def pipeline_apply(
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_stages = sizes[axis]
     if n_stages == 1:
-        return stage_fn(jax.tree_util.tree_map(lambda p: p[0], stage_params), x)
+        params0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        if n_chunks > 1:  # collapse the chunk dim back to one layer stack
+            params0 = jax.tree_util.tree_map(
+                lambda p: p.reshape(p.shape[0] * p.shape[1], *p.shape[2:]), params0
+            )
+        return stage_fn(params0, x)
     data_axes = tuple(a for a in ("dp", "fsdp") if sizes.get(a, 1) > 1)
     local_batch = x.shape[0] // max(1, math.prod(sizes[a] for a in data_axes))
     if local_batch % n_micro:
         raise ValueError(
             f"per-data-shard batch {local_batch} not divisible by n_micro {n_micro}"
+        )
+    if n_chunks > 1:
+        if n_micro % n_stages:
+            raise ValueError(
+                f"interleaved schedule needs n_micro ({n_micro}) divisible by "
+                f"the stage count ({n_stages})"
+            )
+        return _pipeline_apply_interleaved(
+            stage_fn, stage_params, x, mesh, n_micro, n_chunks, axis, sizes,
+            data_axes, with_aux, param_specs, param_prepare,
         )
 
     def per_stage(params_local, x_local):
@@ -129,14 +153,115 @@ def pipeline_apply(
     )(stage_params, x)
 
 
-def stack_stages(layer_params: Any, n_stages: int) -> Any:
-    """(L, ...)-stacked per-layer params -> (S, L/S, ...) stage-stacked."""
+def _pipeline_apply_interleaved(
+    stage_fn, stage_params, x, mesh, n_micro, n_chunks, axis, sizes,
+    data_axes, with_aux, param_specs, param_prepare,
+):
+    """Interleaved (virtual-stage) forward schedule, autodiff-through.
+
+    Rank r holds chunks c = 0..v-1 covering global layer groups c*S + r, so
+    virtual stage j = c*S + r always hands off to rank r+1 (mod S) — ONE
+    ppermute ring, unchanged. Rank r's local slot s runs at global step
+    t = s + r and processes (microbatch i, chunk c) with
+        group = s // (S*v); p = s % (S*v); c = p // S; i = group*S + p % S
+    (Megatron's interleaved order: S microbatches sweep a chunk, then the
+    next chunk, then the next group of S). Ring consistency: (i, c) on rank
+    r consumes rank r-1's same-slot output from step t-1; rank 0 with c >= 1
+    consumes rank S-1's (i, c-1), produced at its slot s-S = step t-1. Total
+    steps m*v + S - 1 for m*v per-rank computes, each 1/v the GPipe stage
+    work: the bubble TIME shrinks by v.
+
+    Kept SEPARATE from the gpipe loop on purpose: gpipe's microbatch/record
+    indices are compile-time constants (static slices, no gathers), which
+    this schedule cannot offer (c and i depend on the traced rank) —
+    unifying would silently demote the common path to dynamic indexing.
+    """
+    n_stages = sizes[axis]
+
+    def per_stage(params_local, x_local):
+        # local leaves: (1, v, Lg, ...) -> (v, Lg, ...)
+        params_local = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        if param_prepare is not None:
+            params_local = param_prepare(params_local)
+        rank = lax.axis_index(axis)
+        batch = x_local.shape[0]
+        mb = batch // n_micro
+        micros = x_local.reshape(n_micro, mb, *x_local.shape[1:])
+        outputs = jnp.zeros_like(micros)
+        carry = jnp.zeros_like(micros[0])
+        ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        total = n_micro * n_chunks
+        aux_total = jnp.float32(0.0)
+        for t in range(total + n_stages - 1):  # static unroll
+            s = t - rank  # traced (rank is)
+            valid = jnp.logical_and(s >= 0, s < total)
+            sc = jnp.clip(s, 0, total - 1)
+            p = sc % (n_stages * n_chunks)
+            c = p // n_stages
+            i = (sc // (n_stages * n_chunks)) * n_stages + p % n_stages
+            chunk_params = jax.tree_util.tree_map(
+                lambda q: lax.dynamic_index_in_dim(q, c, 0, keepdims=False),
+                params_local,
+            )
+            fresh = lax.dynamic_index_in_dim(micros, i, 0, keepdims=False)
+            inject = jnp.logical_and(rank == 0, c == 0)
+            inp = jnp.where(inject, fresh, carry)
+            out = stage_fn(chunk_params, inp)
+            if with_aux:
+                out, aux_t = out
+                aux_total = aux_total + jnp.where(valid, aux_t, 0.0)
+            # virtual last stage: rank S-1, chunk v-1
+            record = jnp.logical_and(
+                valid, jnp.logical_and(rank == n_stages - 1, c == n_chunks - 1)
+            )
+            outputs = outputs.at[i].set(jnp.where(record, out, outputs[i]))
+            carry = lax.ppermute(out, axis, ring)
+        y = outputs.reshape(batch, *x_local.shape[1:])
+        y = lax.psum(jnp.where(rank == n_stages - 1, y, jnp.zeros_like(y)), axis)
+        if not with_aux:
+            return y
+        aux_total = lax.psum(aux_total, axis)
+        for a in data_axes:
+            aux_total = lax.pmean(aux_total, a)
+        return y, aux_total
+
+    x_spec = P(data_axes if data_axes else None)
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    return jax.shard_map(
+        per_stage,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=(x_spec, P()) if with_aux else x_spec,
+        check_vma=False,
+    )(stage_params, x)
+
+
+def stack_stages(layer_params: Any, n_stages: int, n_chunks: int = 1) -> Any:
+    """(L, ...)-stacked per-layer params -> the pipeline storage layout.
+
+    n_chunks == 1: (S, L/S, ...) — rank r holds the consecutive layer block
+    r. n_chunks == v > 1 (INTERLEAVED/virtual stages): (S, v, L/(S*v), ...)
+    where element [r, c] is global layer group c*S + r — rank r holds v
+    non-adjacent chunks, so the pipeline has S*v virtual stages and the
+    fill/drain bubble shrinks by v (each bubble slot is 1/v the work)."""
 
     def reshape(p):
         L = p.shape[0]
-        if L % n_stages:
-            raise ValueError(f"{L} layers not divisible into {n_stages} stages")
-        return p.reshape(n_stages, L // n_stages, *p.shape[1:])
+        if L % (n_stages * n_chunks):
+            raise ValueError(
+                f"{L} layers not divisible into {n_stages} stages"
+                + (f" x {n_chunks} chunks" if n_chunks > 1 else "")
+            )
+        if n_chunks == 1:
+            return p.reshape(n_stages, L // n_stages, *p.shape[1:])
+        lg = L // (n_stages * n_chunks)
+        groups = p.reshape(n_stages * n_chunks, lg, *p.shape[1:])
+        # [r, c] = group c*S + r
+        order = jnp.asarray(
+            [c * n_stages + r for r in range(n_stages) for c in range(n_chunks)]
+        )
+        return groups[order].reshape(n_stages, n_chunks, lg, *p.shape[1:])
 
     return jax.tree_util.tree_map(reshape, layer_params)
 
