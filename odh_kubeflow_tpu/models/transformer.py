@@ -54,6 +54,12 @@ class TransformerConfig:
     remat_policy: str = ""
     use_flash: bool = True
     seq_axis: str = ""  # set to "sp" to run ring attention over that mesh axis
+    # Sequence-shard layout for the ring ("contiguous" | "zigzag"). Zigzag
+    # (shard r holds chunks r and 2S-1-r of the sequence) load-balances the
+    # causal ring: every rank computes ~2 block-units per visit instead of
+    # rank S-1 doing full work while rank 0 skips — ~2x ring wall-clock.
+    # Callers must feed zigzag-ordered batches (models.make_zigzag_batch).
+    seq_layout: str = "contiguous"
     # Mixture-of-Experts: set to swap every layer's FFN for routed experts
     # (models/moe.py; expert weights shard over the `ep` mesh axis)
     moe: Optional[MoEConfig] = None
@@ -193,16 +199,30 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
     if cfg.seq_axis and mesh is not None:
         # ppermute needs bound axis names: run the ring under shard_map over
         # the FULL mesh; only `sp` collectives occur, other axes stay local.
+        if cfg.seq_layout == "zigzag":
+            from ..ops.ring_attention import ring_attention_zigzag
+
+            ring = partial(ring_attention_zigzag, axis_name=cfg.seq_axis)
+        else:
+            ring = partial(ring_attention, axis_name=cfg.seq_axis, causal=True)
         q_spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), mesh)
         kv_spec = logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), mesh)
         fn = jax.shard_map(
-            partial(ring_attention, axis_name=cfg.seq_axis, causal=True),
+            ring,
             mesh=mesh,
             in_specs=(q_spec, kv_spec, kv_spec),
             out_specs=q_spec,
             check_vma=False,
         )
         return fn(q, k, v)
+    if cfg.seq_layout == "zigzag":
+        # zigzag TOKEN ORDER with a storage-order causal mask would be
+        # silently wrong (non-monotonic positions): only the ring path
+        # understands the layout
+        raise ValueError(
+            'seq_layout="zigzag" requires a live ring (cfg.seq_axis set and '
+            "a mesh passed to forward/loss_fn)"
+        )
     if cfg.use_flash:
         return flash_attention(q, k, v, causal=True)  # falls back off-TPU
     return mha_reference(q, k, v, causal=True)
@@ -357,6 +377,36 @@ def forward(
     return logits
 
 
+def make_zigzag_batch(tokens, sp: int):
+    """Build the zigzag-ordered training batch for cfg.seq_layout="zigzag":
+    tokens permuted into zigzag storage order, next-token targets computed
+    in NATURAL order first (so cross-chunk boundaries are right), and
+    per-token global positions for rope/causal masking, and a loss_mask
+    zeroing the one fabricated label (natural position s-1's rolled target
+    is token 0). With the mask, loss_fn equals the contiguous path's
+    logits[:, :-1] loss EXACTLY."""
+    import numpy as np
+
+    from ..ops.ring_attention import zigzag_permutation
+
+    b, s = tokens.shape
+    perm = zigzag_permutation(s, sp)
+    targets_nat = jnp.roll(tokens, -1, axis=1)
+    positions = jnp.broadcast_to(
+        jnp.asarray(np.asarray(perm), jnp.int32)[None, :], (b, s)
+    )
+    # position s-1's rolled target is the sequence's FIRST token — a
+    # fabricated label; mask it out so the loss equals the contiguous
+    # path's logits[:, :-1] convention exactly
+    mask = (positions != s - 1).astype(jnp.float32)
+    return {
+        "tokens": tokens[:, perm],
+        "targets": targets_nat[:, perm],
+        "positions": positions,
+        "loss_mask": mask,
+    }
+
+
 def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
     """Causal LM cross-entropy (+ router load-balance aux for MoE configs).
     batch: {"tokens": (b, s), "positions"?}."""
@@ -365,11 +415,16 @@ def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
         params, tokens, cfg, mesh=mesh, positions=batch.get("positions"), with_aux=True
     )
     targets = batch.get("targets")
+    mask = batch.get("loss_mask")  # only meaningful with explicit targets
     if targets is None:
         logits, targets = logits[:, :-1], tokens[:, 1:]
+        mask = None
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    if mask is not None:
+        loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        loss = -jnp.mean(ll)
     if cfg.moe is not None:
         loss = loss + cfg.moe.router_aux_weight * aux / cfg.n_layers
     return loss

@@ -581,7 +581,14 @@ def _dkv_maps(balanced, causal, block_q, block_k, num_qb, num_kb):
     return row_map, kv_map
 
 
-def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret,
+                    g_lse=None):
+    """g_lse (optional, (b*hk, group, sq) f32): cotangent of the forward's
+    log-sum-exp output. Since d lse/d s = p, it enters the FlashAttention-2
+    decomposition as ds = p*(dp - (delta - g_lse))*scale — i.e. the lse
+    cotangent just SUBTRACTS from delta. This is what makes per-block
+    (out, lse) pairs fully differentiable building blocks for ring
+    compositions (the merge weights' gradients flow through g_lse)."""
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
     group = h // hk
@@ -596,6 +603,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     vt = v.transpose(0, 2, 1, 3).reshape(bh, sk, d)
     # delta = rowsum(do ⊙ o), lane-broadcast to the lse layout
     delta = jnp.sum(gt.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse
     delta = jnp.broadcast_to(delta[..., None], (bh, group, sq, 128))
 
     from jax.experimental.pallas import tpu as pltpu

@@ -301,3 +301,213 @@ def ring_attention(
     if use_kernel:
         return _ring_kernel(q, k, v, axis_name, causal, interpret)
     return _ring_reference(q, k, v, axis_name, causal)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag layout: causal load balancing (opt-in)
+#
+# Under the contiguous layout, lockstep SPMD makes every ring step cost a
+# full block on the busiest rank while later-shard ranks SKIP (the collective
+# synchronizes them anyway): causal ring wall-clock ~= S full-block steps for
+# S/2 average useful blocks per rank — 2x off balanced. Zigzag sharding fixes
+# the imbalance: with 2S equal chunks of the sequence, sp rank r stores
+# [chunk r | chunk 2S-1-r]. Per visit (local q vs the visiting rank's K/V),
+# the 4 chunk pairs classify STATICALLY by chunk ids:
+#   qa vs ka : diag if src == my, full if src < my, skip otherwise
+#   qa vs kb : always skip        (kb's chunk id >= S > qa's)
+#   qb vs ka : always full        (qb's chunk id >= S > ka's)
+#   qb vs kb : diag if src == my, full if src > my, skip otherwise
+# i.e. EVERY rank computes exactly 2 block-units per visit (1 full + 1
+# full-or-diag) — balanced, for the same total FLOPs.
+# ---------------------------------------------------------------------------
+
+
+def zigzag_permutation(seq_len: int, sp: int):
+    """Natural-order positions in zigzag storage order: the concatenation,
+    over ranks r, of chunk r then chunk 2*sp-1-r (chunk = seq_len/(2*sp)).
+    Use to build a zigzag batch: tokens_zz = tokens[:, perm],
+    positions_zz = perm (feed as batch["positions"])."""
+    import numpy as np
+
+    chunk = seq_len // (2 * sp)
+    if chunk * 2 * sp != seq_len:
+        raise ValueError(f"seq_len {seq_len} not divisible by 2*sp={2*sp}")
+    order = []
+    for r in range(sp):
+        order += list(range(r * chunk, (r + 1) * chunk))
+        g = 2 * sp - 1 - r
+        order += list(range(g * chunk, (g + 1) * chunk))
+    return np.asarray(order)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_block_with_lse(q, k, v, causal, interpret):
+    """Differentiable (out, lse) flash block — the building unit for ring
+    compositions: out in q.dtype, lse (b, sq, h) f32 natural-log. The
+    backward folds the lse cotangent into the FlashAttention-2 delta
+    (ds = p*(dp - (delta - g_lse))*scale), so arbitrary jnp merges of
+    (out, lse) pairs autodiff exactly."""
+    out, lse = _flash_block_fwd_impl(q, k, v, causal, interpret)
+    return out, lse
+
+
+def _flash_block_fwd_impl(q, k, v, causal, interpret):
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    bq, bk = _block_sizes(sq, k.shape[1])
+    out, lse_k = _flash_forward_kernel(
+        q, k, v, causal, bq, bk, interpret, with_lse=True
+    )
+    return out, _lse_to_bsh(lse_k, b, hk, h // hk, sq)
+
+
+def _flash_block_fwd(q, k, v, causal, interpret):
+    out, lse = _flash_block_fwd_impl(q, k, v, causal, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_block_bwd(causal, interpret, res, cts):
+    q, k, v, out, lse = res
+    g_out, g_lse = cts
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    g = h // hk
+    bq, bk = _block_sizes(sq, k.shape[1])
+    lse_k = _lse_to_kernel(lse, b, hk, g, sq)
+    # (b, sq, h) -> the grouped (b*hk, group, sq) delta layout
+    g_lse_k = g_lse.transpose(0, 2, 1).reshape(b * hk, g, sq)
+    return _flash_backward(
+        q, k, v, out, lse_k, g_out.astype(q.dtype), causal, bq, bk, interpret,
+        g_lse=g_lse_k.astype(jnp.float32),
+    )
+
+
+flash_block_with_lse.defvjp(_flash_block_fwd, _flash_block_bwd)
+
+
+def _zz_pair(q_half, kv, blk_causal, interpret, use_kernel, q_off, k_off):
+    """One (q chunk) x (k chunk) pair -> (out_f32, lse) in (b, sq, h) space.
+    Chunks are equal-length, so 'diag' pairs are the standard causal kernel
+    and 'full' pairs are mask-free — offsets only matter on the reference
+    path (the kernel path never masks by absolute position)."""
+    b, sq, h, d = q_half.shape
+    k_, v_ = kv
+    if use_kernel:
+        out_b, lse = flash_block_with_lse(q_half, k_, v_, blk_causal, interpret)
+        return out_b.astype(jnp.float32), lse
+    hk = k_.shape[2]
+    g = h // hk
+    sm = d**-0.5
+    m, l, acc = _local_block(q_half, k_, v_, q_off, k_off, blk_causal, sm)
+    out = (acc / jnp.maximum(l, 1e-30)).transpose(0, 3, 1, 2, 4).reshape(
+        b, sq, h, d
+    )
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (b, hk, g, sq)
+    lse = lse.reshape(b, hk * g, sq).transpose(0, 2, 1)
+    return out, lse
+
+
+def _zz_skip(b, sq, h, d):
+    return (
+        jnp.zeros((b, sq, h, d), jnp.float32),
+        jnp.full((b, sq, h), NEG_INF, jnp.float32),
+    )
+
+
+def ring_attention_zigzag(
+    q, k, v, axis_name: str = "sp", interpret: bool = False,
+    use_kernel=None,
+):
+    """Causal ring attention over ZIGZAG-sharded sequences: the local shard
+    is [chunk my | chunk 2S-1-my] (zigzag_permutation order). Exact; load-
+    balanced (every rank computes ~2 block-units per visit). Differentiable
+    via autodiff on the reference path; the kernel path composes the same
+    custom-VJP flash blocks per pair."""
+    axis_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    chunk = sl // 2
+    if use_kernel is None:
+        from ..tpu.detect import tpu_like
+
+        hk = k.shape[2]
+        bq, bk = _block_sizes(chunk, chunk)
+        use_kernel = (
+            (tpu_like() or interpret)
+            and h % hk == 0
+            and chunk % bq == 0
+            and bq >= 8
+            and bk >= 128
+        )
+
+    def halves(t):
+        return t[:, :chunk], t[:, chunk:]
+
+    qa, qb = halves(q)
+
+    def visit(out_a, lse_a, out_b, lse_b, kc, vc, src):
+        ka, kb = halves(kc)
+        va, vb = halves(vc)
+        two_s = 2 * axis_size
+
+        def off(cid):
+            return cid * chunk
+
+        # qa vs ka: diag / full(src<my) / skip
+        pa = lax.switch(
+            jnp.where(src == my, 2, jnp.where(src < my, 1, 0)),
+            [
+                lambda: _zz_skip(b, chunk, h, d),
+                lambda: _zz_pair(qa, (ka, va), False, interpret, use_kernel,
+                                 off(my), off(src)),
+                lambda: _zz_pair(qa, (ka, va), True, interpret, use_kernel,
+                                 off(my), off(src)),
+            ],
+        )
+        out_a, lse_a = _merge(out_a, lse_a, *pa)
+        # qb vs ka: always full
+        pba = _zz_pair(qb, (ka, va), False, interpret, use_kernel,
+                       off(two_s - 1 - my), off(src))
+        out_b, lse_b = _merge(out_b, lse_b, *pba)
+        # qb vs kb: diag / full(src>my) / skip
+        pbb = lax.switch(
+            jnp.where(src == my, 2, jnp.where(src > my, 1, 0)),
+            [
+                lambda: _zz_skip(b, chunk, h, d),
+                lambda: _zz_pair(qb, (kb, vb), False, interpret, use_kernel,
+                                 off(two_s - 1 - my), off(two_s - 1 - src)),
+                lambda: _zz_pair(qb, (kb, vb), True, interpret, use_kernel,
+                                 off(two_s - 1 - my), off(two_s - 1 - src)),
+            ],
+        )
+        out_b, lse_b = _merge(out_b, lse_b, *pbb)
+        # qa vs kb: always skip (no compute, no merge)
+        return out_a, lse_a, out_b, lse_b
+
+    # visit 0: own shard
+    za = _zz_skip(b, chunk, h, d)
+    zb = _zz_skip(b, chunk, h, d)
+    out_a, lse_a, out_b, lse_b = visit(*za, *zb, k, v, my)
+    if axis_size > 1:
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        kc = lax.ppermute(k, axis_name, perm)
+        vc = lax.ppermute(v, axis_name, perm)
+
+        def step(i, carry):
+            out_a, lse_a, out_b, lse_b, kc, vc = carry
+            src = (my - i) % axis_size
+            out_a, lse_a, out_b, lse_b = visit(
+                out_a, lse_a, out_b, lse_b, kc, vc, src
+            )
+            return (out_a, lse_a, out_b, lse_b,
+                    lax.ppermute(kc, axis_name, perm),
+                    lax.ppermute(vc, axis_name, perm))
+
+        out_a, lse_a, out_b, lse_b, k_last, v_last = lax.fori_loop(
+            1, axis_size - 1, step, (out_a, lse_a, out_b, lse_b, kc, vc)
+        )
+        src_last = (my - (axis_size - 1)) % axis_size
+        out_a, lse_a, out_b, lse_b = visit(
+            out_a, lse_a, out_b, lse_b, k_last, v_last, src_last
+        )
+    return jnp.concatenate([out_a, out_b], axis=1).astype(q.dtype)

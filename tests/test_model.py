@@ -260,3 +260,41 @@ def test_remat_policies_preserve_loss_and_grads():
 
     with pytest.raises(ValueError):
         loss_fn(params, {"tokens": tokens}, replace(base, remat_policy="bogus"))
+
+
+def test_zigzag_seq_layout_loss_matches_natural():
+    """cfg.seq_layout="zigzag" + make_zigzag_batch on an sp=2 mesh: the LM
+    loss equals the natural-order loss on the full batch (the mean over
+    tokens is permutation-invariant and targets were shifted in natural
+    order), with GQA ring attention running load-balanced."""
+    from dataclasses import replace
+
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from odh_kubeflow_tpu.models import param_specs
+    from odh_kubeflow_tpu.models.transformer import make_zigzag_batch
+    from odh_kubeflow_tpu.parallel import MeshPlan, shard_batch
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+        dtype=jnp.float32, use_flash=False, remat=False, seq_axis="sp",
+        seq_layout="zigzag",
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    # natural-order reference: the STANDARD contiguous loss (logits[:, :-1]
+    # vs tokens[:, 1:]) — make_zigzag_batch's loss_mask makes the zigzag
+    # loss equal it exactly (the wrap-around label is masked out)
+    nat_cfg = replace(cfg, seq_axis="", seq_layout="contiguous")
+    ref = loss_fn(params, {"tokens": tokens}, nat_cfg)
+
+    mesh = MeshPlan(sp=2).build(jax.devices()[:2])
+    specs = param_specs(cfg, mesh)
+    sharded = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
+    )
+    zz = shard_batch(mesh, make_zigzag_batch(tokens, sp=2))
+    got = jax.jit(lambda p, b: loss_fn(p, b, cfg, mesh))(sharded, zz)
+    assert np.allclose(float(got), float(ref), atol=1e-5)
