@@ -157,9 +157,15 @@ def _prompt_scan(params, tokens: jnp.ndarray, cfg: TransformerConfig):
     """Shared prompt forward: last-position logits plus the stacked
     (L, b, s, kv_heads, head_dim) K/V — flash attention does the O(s²) work.
     prefill and _prefill_parts differ only in how they package the K/V."""
+    from dataclasses import replace
+
     from .transformer import _attention
 
     b, s = tokens.shape
+    # inference prompts are NATURAL-order on one device: plain contiguous
+    # causal attention is exactly right even for models trained with
+    # seq_axis/zigzag sharding (those are training-time distribution knobs)
+    cfg = replace(cfg, seq_axis="", seq_layout="contiguous")
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
     x = params["embed"].astype(cfg.dtype)[tokens]
 

@@ -420,16 +420,19 @@ def ring_attention_zigzag(
 ):
     """Causal ring attention over ZIGZAG-sharded sequences: the local shard
     is [chunk my | chunk 2S-1-my] (zigzag_permutation order). Exact; load-
-    balanced (every rank computes ~2 block-units per visit). Differentiable
-    via autodiff on the reference path; the kernel path composes the same
-    custom-VJP flash blocks per pair."""
-    axis_size = lax.psum(1, axis_name)
-    my = lax.axis_index(axis_name)
-    b, sl, h, d = q.shape
-    chunk = sl // 2
+    balanced (every rank computes ~2 block-units per visit).
+
+    Kernel path: custom VJP — the backward is a second ring pass running
+    the flash backward kernels per chunk pair under the GLOBAL per-half
+    lse/delta (like the contiguous ring), so no per-visit K/V residuals are
+    stored: per-device memory stays O(local), which is the point of
+    sequence parallelism. Reference path (CPU tests): plain autodiff
+    through the per-pair einsums."""
     if use_kernel is None:
         from ..tpu.detect import tpu_like
 
+        b, sl, h, d = q.shape
+        chunk = sl // 2
         hk = k.shape[2]
         bq, bk = _block_sizes(chunk, chunk)
         use_kernel = (
@@ -439,6 +442,108 @@ def ring_attention_zigzag(
             and bq >= 8
             and bk >= 128
         )
+    if use_kernel:
+        return _ring_zz_kernel(q, k, v, axis_name, interpret)
+    return _ring_zigzag_impl(q, k, v, axis_name, interpret, False)[0]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_zz_kernel(q, k, v, axis_name, interpret):
+    return _ring_zigzag_impl(q, k, v, axis_name, interpret, True)[0]
+
+
+def _ring_zz_kernel_fwd(q, k, v, axis_name, interpret):
+    out, (lse_a, lse_b) = _ring_zigzag_impl(q, k, v, axis_name, interpret, True)
+    return out, (q, k, v, out, lse_a, lse_b)
+
+
+def _ring_zz_kernel_bwd(axis_name, interpret, res, grad):
+    """Second ring pass: per visit, the same 4-pair classification, each
+    live pair running the flash backward kernels with the GLOBAL per-half
+    lse (recomputed p are the true global probabilities — exact under
+    partitioned K). dq halves accumulate locally; (dk, dv) accumulators
+    ride the ring with their K/V shard and arrive home after the full
+    cycle."""
+    q, k, v, out, lse_a, lse_b = res
+    axis_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    chunk = sl // 2
+    hk = k.shape[2]
+    g = h // hk
+    bq, bk = _block_sizes(chunk, chunk)
+    grad = grad.astype(q.dtype)
+
+    qa, qb = q[:, :chunk], q[:, chunk:]
+    oa, ob = out[:, :chunk], out[:, chunk:]
+    ga, gb = grad[:, :chunk], grad[:, chunk:]
+    lka = _lse_to_kernel(lse_a, b, hk, g, chunk)
+    lkb = _lse_to_kernel(lse_b, b, hk, g, chunk)
+
+    def pair_bwd(qh, oh, lseh, gh, kh, vh, blk_causal):
+        return _flash_backward(
+            qh, kh, vh, oh, lseh, gh, blk_causal, bq, bk, interpret
+        )
+
+    def zero_pair():
+        return (
+            jnp.zeros((b, chunk, h, d), q.dtype),
+            jnp.zeros((b, chunk, hk, d), k.dtype),
+            jnp.zeros((b, chunk, hk, d), v.dtype),
+        )
+
+    def visit_bwd(kc, vc, src):
+        ka, kb = kc[:, :chunk], kc[:, chunk:]
+        va, vb = vc[:, :chunk], vc[:, chunk:]
+        # qa vs ka: diag / full(src<my) / skip
+        dqa1, dka1, dva1 = lax.switch(
+            jnp.where(src == my, 2, jnp.where(src < my, 1, 0)),
+            [zero_pair,
+             lambda: pair_bwd(qa, oa, lka, ga, ka, va, False),
+             lambda: pair_bwd(qa, oa, lka, ga, ka, va, True)],
+        )
+        # qb vs ka: always full
+        dqb1, dka2, dva2 = pair_bwd(qb, ob, lkb, gb, ka, va, False)
+        # qb vs kb: diag / full(src>my) / skip
+        dqb2, dkb1, dvb1 = lax.switch(
+            jnp.where(src == my, 2, jnp.where(src > my, 1, 0)),
+            [zero_pair,
+             lambda: pair_bwd(qb, ob, lkb, gb, kb, vb, False),
+             lambda: pair_bwd(qb, ob, lkb, gb, kb, vb, True)],
+        )
+        dq_v = jnp.concatenate([dqa1, dqb1 + dqb2], axis=1).astype(jnp.float32)
+        dk_v = jnp.concatenate([dka1 + dka2, dkb1], axis=1).astype(jnp.float32)
+        dv_v = jnp.concatenate([dva1 + dva2, dvb1], axis=1).astype(jnp.float32)
+        return dq_v, dk_v, dv_v
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(i, carry):
+        dq, dk_acc, dv_acc, kc, vc = carry
+        src = (my - i) % axis_size
+        dq_v, dk_v, dv_v = visit_bwd(kc, vc, src)
+        dq = dq + dq_v
+        dk_acc = dk_acc + dk_v
+        dv_acc = dv_acc + dv_v
+        rot = lambda x: lax.ppermute(x, axis_name, perm)
+        return dq, rot(dk_acc), rot(dv_acc), rot(kc), rot(vc)
+
+    dq0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    dkv0 = jnp.zeros((b, sl, hk, d), jnp.float32)
+    dq, dk, dv, _, _ = lax.fori_loop(
+        0, axis_size, step, (dq0, dkv0, dkv0, k, v)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_zz_kernel.defvjp(_ring_zz_kernel_fwd, _ring_zz_kernel_bwd)
+
+
+def _ring_zigzag_impl(q, k, v, axis_name, interpret, use_kernel):
+    axis_size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    chunk = sl // 2
 
     def halves(t):
         return t[:, :chunk], t[:, chunk:]
@@ -510,4 +615,5 @@ def ring_attention_zigzag(
         out_a, lse_a, out_b, lse_b = visit(
             out_a, lse_a, out_b, lse_b, k_last, v_last, src_last
         )
-    return jnp.concatenate([out_a, out_b], axis=1).astype(q.dtype)
+    out = jnp.concatenate([out_a, out_b], axis=1).astype(q.dtype)
+    return out, (lse_a, lse_b)

@@ -353,3 +353,38 @@ def test_zigzag_ring_attention_parity(sp, kv_heads, kernel):
         want = np.asarray(b)[:, perm]
         scale = max(float(np.max(np.abs(want))), 1.0)
         assert float(np.max(np.abs(np.asarray(a) - want))) / scale < tol, name
+
+
+def test_flash_block_with_lse_merge_grads():
+    """flash_block_with_lse is a differentiable building block: composing
+    two K/V blocks via the log-sum-exp _merge must match attention over the
+    concatenated K/V — values AND q/k/v gradients (this exercises the lse
+    cotangent folded into the backward's delta)."""
+    import numpy as np
+
+    from odh_kubeflow_tpu.ops.ring_attention import _merge, flash_block_with_lse
+
+    b, s, h, d = 1, 256, 2, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, 2 * s, h, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, 2 * s, h, d), jnp.float32)
+
+    def loss_merged(q_, k_, v_):
+        o1, l1 = flash_block_with_lse(q_, k_[:, :s], v_[:, :s], False, True)
+        o2, l2 = flash_block_with_lse(q_, k_[:, s:], v_[:, s:], False, True)
+        out, _ = _merge(o1.astype(jnp.float32), l1, o2.astype(jnp.float32), l2)
+        return jnp.sum(out**2), out
+
+    def loss_ref(q_, k_, v_):
+        out = mha_reference(q_, k_, v_, causal=False).astype(jnp.float32)
+        return jnp.sum(out**2), out
+
+    (_, om), gm = jax.value_and_grad(loss_merged, argnums=(0, 1, 2),
+                                     has_aux=True)(q, k, v)
+    (_, orf), gr = jax.value_and_grad(loss_ref, argnums=(0, 1, 2),
+                                      has_aux=True)(q, k, v)
+    assert float(jnp.max(jnp.abs(om - orf))) < 2e-2
+    for name, a, b_ in zip("qkv", gm, gr):
+        scale = max(float(np.max(np.abs(np.asarray(b_)))), 1.0)
+        assert float(np.max(np.abs(np.asarray(a) - np.asarray(b_)))) / scale \
+            < 2e-2, name
