@@ -138,6 +138,22 @@ class ProbeStatusController:
             if any(c.type == "Ready" and c.status == "True" for c in p.status.conditions)
         )
 
+        tpu_pub = nb.status.tpu
+        if ready_pods < shape.hosts and not (
+            tpu_pub and (tpu_pub.mesh_ready or tpu_pub.chips_visible)
+        ):
+            # Pods still coming up AND nothing is published as up: probing
+            # every ordinal now mostly hits unreachable agents, and under a
+            # create storm those wasted probe cycles are real contention
+            # (every notebook event during bring-up re-triggered a full
+            # probe sweep). Wait for the pod facts — the pod-Ready event
+            # chain re-enqueues this notebook — with the periodic requeue
+            # as the backstop. A DEGRADED slice (mesh_ready or chips
+            # currently published) deliberately falls through: the probe
+            # sweep is what downgrades the gate and the chip count after a
+            # host loss or restart.
+            return Result(requeue_after=period_s)
+
         reports = self.collect_reports(nb, shape.hosts)
         chips_visible = sum(int(r.get("chips_visible", 0)) for r in reports if r)
         hosts_reporting_ready = sum(1 for r in reports if r and r.get("ready"))

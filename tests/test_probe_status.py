@@ -165,3 +165,30 @@ def test_unreachable_probe_keeps_gate_closed(env):
     time.sleep(1.0)  # several probe periods
     tpu = get_nb(cluster, "mute").status.tpu
     assert tpu is None or tpu.mesh_ready is False
+
+
+def test_mesh_ready_downgrades_after_host_loss(env):
+    """Bring-up probing is gated on pod readiness, but a DEGRADED slice must
+    still downgrade: once mesh_ready is published, losing a host flips it
+    back off (and the chip count drops) even though ready_pods < hosts."""
+    from odh_kubeflow_tpu.api.core import Pod
+
+    cluster, agents = env
+    cluster.client.create(mk_nb("lossy", topology="2x2x4", accelerator="v5p"))
+    got = wait_for(
+        lambda: (
+            lambda n: n if n.status.tpu and n.status.tpu.mesh_ready else None
+        )(get_nb(cluster, "lossy")),
+        msg="mesh ready", timeout=60,
+    )
+    assert got.status.tpu.chips_visible == 16
+
+    # lose a host: the probe cycle must observe the gap and downgrade
+    cluster.client.delete(Pod, NS, "lossy-2")
+    wait_for(
+        lambda: (
+            lambda n: True
+            if n.status.tpu and not n.status.tpu.mesh_ready else None
+        )(get_nb(cluster, "lossy")),
+        msg="mesh downgraded", timeout=60,
+    )
