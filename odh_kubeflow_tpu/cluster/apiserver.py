@@ -208,7 +208,13 @@ class ApiServer:
                 pass
 
     def _dispatch(self, h: BaseHTTPRequestHandler, method: str) -> None:
-        outcome = "ok"
+        # Audit ORDERING contract: the record is written BEFORE the response
+        # bytes are flushed to the client, so a client that reads the audit
+        # log immediately after receiving a response always finds its own
+        # request recorded (the debug escape exists so operators can trust
+        # the log reflects completed requests). Verb handlers therefore
+        # RETURN (code, body) instead of writing to the socket; the one
+        # streaming verb (watch) audits at stream start.
         try:
             if not self._authorized(h):
                 raise UnauthorizedError("missing or invalid bearer token")
@@ -219,35 +225,42 @@ class ApiServer:
                 raise NotFoundError(f"the server could not find the requested resource {parsed.path!r}")
             if method == "GET":
                 if route.name:
-                    self._get(h, route)
+                    code, body = self._get(h, route)
                 elif query.get("watch") in ("true", "1"):
-                    self._watch(h, route, query)
+                    self._watch(h, route, query, method)
+                    return
                 else:
-                    self._list(h, route, query)
+                    code, body = self._list(h, route, query)
             elif method == "POST" and not route.name:
-                self._create(h, route)
+                code, body = self._create(h, route)
             elif method == "PUT" and route.name:
-                self._update(h, route)
+                code, body = self._update(h, route)
             elif method == "PATCH" and route.name:
-                self._patch(h, route)
+                code, body = self._patch(h, route)
             elif method == "DELETE" and route.name:
-                self._delete(h, route)
+                code, body = self._delete(h, route)
             else:
                 raise InvalidError(f"unsupported {method} on {parsed.path!r}")
         except ApiError as e:
-            outcome = f"{e.code} {e.reason}"
+            self._audit(method, h.path, f"{e.code} {e.reason}")
             self._send_status_error(h, e)
+            return
         except (BrokenPipeError, ConnectionResetError):
-            outcome = "client-gone"
+            self._audit(method, h.path, "client-gone")
+            return
         except Exception as e:  # never leak a stack trace into the connection
-            outcome = f"internal: {e!r}"
+            self._audit(method, h.path, f"internal: {e!r}")
             err = ApiError(f"internal error: {e!r}")
             try:
                 self._send_status_error(h, err)
             except OSError:
                 pass
-        finally:
-            self._audit(method, h.path, outcome)
+            return
+        self._audit(method, h.path, "ok")
+        try:
+            self._send_json(h, code, body)
+        except OSError:  # client gone mid-send (incl. TLS aborts)
+            pass
 
     def _authorized(self, h: BaseHTTPRequestHandler) -> bool:
         if self.bearer_token is None:
@@ -318,11 +331,11 @@ class ApiServer:
             return self.admission(operation, obj, old)
         return obj
 
-    def _get(self, h, route: _Route) -> None:
+    def _get(self, h, route: _Route) -> Tuple[int, Dict[str, Any]]:
         obj = self.store.get_raw(route.api_version, route.kind, route.namespace, route.name)
-        self._send_json(h, 200, obj)
+        return 200, obj
 
-    def _list(self, h, route: _Route, query: Dict[str, str]) -> None:
+    def _list(self, h, route: _Route, query: Dict[str, str]) -> Tuple[int, Dict[str, Any]]:
         selector = parse_label_selector(query.get("labelSelector", ""))
         items, rv = self.store.list_raw_with_rv(
             route.api_version,
@@ -330,18 +343,14 @@ class ApiServer:
             namespace=route.namespace if route.namespaced else None,
             label_selector=selector,
         )
-        self._send_json(
-            h,
-            200,
-            {
-                "apiVersion": route.api_version,
-                "kind": f"{route.kind}List",
-                "metadata": {"resourceVersion": rv},
-                "items": items,
-            },
-        )
+        return 200, {
+            "apiVersion": route.api_version,
+            "kind": f"{route.kind}List",
+            "metadata": {"resourceVersion": rv},
+            "items": items,
+        }
 
-    def _create(self, h, route: _Route) -> None:
+    def _create(self, h, route: _Route) -> Tuple[int, Dict[str, Any]]:
         obj = self._read_body(h)
         meta = obj.setdefault("metadata", {})
         if route.namespaced:
@@ -350,9 +359,9 @@ class ApiServer:
         obj.setdefault("kind", route.kind)
         obj = self._admit("CREATE", obj, None)
         out = self.store.create_raw(obj)
-        self._send_json(h, 201, out)
+        return 201, out
 
-    def _update(self, h, route: _Route) -> None:
+    def _update(self, h, route: _Route) -> Tuple[int, Dict[str, Any]]:
         obj = self._read_body(h)
         if route.subresource not in ("", "status"):
             raise InvalidError(f"unsupported subresource {route.subresource!r}")
@@ -365,9 +374,9 @@ class ApiServer:
                 old = None
             obj = self._admit("UPDATE", obj, old)
         out = self.store.update_raw(obj, subresource=route.subresource)
-        self._send_json(h, 200, out)
+        return 200, out
 
-    def _patch(self, h, route: _Route) -> None:
+    def _patch(self, h, route: _Route) -> Tuple[int, Dict[str, Any]]:
         patch = self._read_body(h)
         ctype = h.headers.get("Content-Type", "application/merge-patch+json")
         if route.subresource not in ("", "status"):
@@ -422,17 +431,15 @@ class ApiServer:
                     patch,
                     subresource=route.subresource,
                 )
-        self._send_json(h, 200, out)
+        return 200, out
 
-    def _delete(self, h, route: _Route) -> None:
+    def _delete(self, h, route: _Route) -> Tuple[int, Dict[str, Any]]:
         self.store.delete_raw(route.api_version, route.kind, route.namespace, route.name)
-        self._send_json(
-            h, 200, {"kind": "Status", "apiVersion": "v1", "status": "Success"}
-        )
+        return 200, {"kind": "Status", "apiVersion": "v1", "status": "Success"}
 
     # -- watch streaming --
 
-    def _watch(self, h, route: _Route, query: Dict[str, str]) -> None:
+    def _watch(self, h, route: _Route, query: Dict[str, str], method: str = "GET") -> None:
         since_rv = query.get("resourceVersion") or None
         bookmarks = query.get("allowWatchBookmarks") in ("true", "1")
         selector = parse_label_selector(query.get("labelSelector", ""))
@@ -443,6 +450,10 @@ class ApiServer:
             send_initial=since_rv is None,
             since_rv=since_rv,
         )
+        # audit only once the watch is established (a 410/invalid-RV raise
+        # above flows to _dispatch's ApiError record instead) and before the
+        # stream's first bytes flush — the ordering contract
+        self._audit(method, h.path, "watch")
         with self._watch_lock:
             self._active_watches.append(w)
         try:

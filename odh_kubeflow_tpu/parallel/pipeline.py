@@ -279,6 +279,8 @@ def pipeline_value_and_grad_1f1b(
     param_specs: Any = None,
     param_prepare: Optional[Callable[[Any], Any]] = None,
     tp_axis: str = "",
+    aux_weight: Optional[float] = None,
+    ep_axis: str = "",
 ):
     """1F1B pipeline schedule: loss AND gradients in one interleaved pass.
 
@@ -308,8 +310,21 @@ def pipeline_value_and_grad_1f1b(
     ZeRO-stored weights all-gather forward and reduce-scatter their
     gradients via the transpose; tp_axis marks stage compute as
     tensor-partitioned so replicated-leaf gradients psum over tp. head
-    params enter replicated (P()). The aux-loss channel is not threaded —
-    MoE configs keep the GPipe schedule.
+    params enter replicated (P()).
+
+    aux_weight is the MoE router-aux channel: when set, stage_fn returns
+    (y, aux_scalar) and the total loss adds
+    aux_weight * (sum over stages and real microbatches of aux) / n_micro —
+    the same normalization pp_loss_fn applies to GPipe's threaded aux. The
+    gradient needs no separate machinery: d(total)/d(aux_{stage,micro}) is
+    the CONSTANT aux_weight (up to the shared scale), so each backward
+    half-step seeds its recompute-vjp with (dy, aux_weight) and the aux
+    path's parameter/input cotangents ride the existing accumulators. The
+    tp bookkeeping below stays correct for aux-path leaves: replicated
+    leaves whose path crosses no tp psum (router/expert weights — MoE
+    compute is tp-replicated) come out of the local vjp UNinflated, and the
+    explicit psum-over-tp x tp_fix in finish_stage is exactly pmean, a
+    no-op on replicated values.
     """
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_stages = sizes[axis]
@@ -325,24 +340,29 @@ def pipeline_value_and_grad_1f1b(
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     live_tp = tp_axis and sizes.get(tp_axis, 1) > 1
+    live_ep = ep_axis and sizes.get(ep_axis, 1) > 1
+    # Axes with MANUAL collectives inside the stage (tp: row-parallel
+    # psums; ep: the MoE combine psum). The local-vjp transpose rule
+    # (psum -> psum, verified numerically) makes the per-rank cotangent of
+    # any value = (replicated paths) + size * (own-rank-only paths through
+    # that axis's psum). Hence the uniform correction per axis a:
+    #   - leaf STORED sharded on a (distinct shards): its true gradient is
+    #     exactly the own-rank paths, each crossing a's psum once -> / size;
+    #   - leaf replicated over a: pmean over a is exact for BOTH path kinds
+    #     (replicated paths average to themselves; size*own_r paths
+    #     pmean to sum_r own_r);
+    #   - dx (replicated activations): pmean per hop, same argument.
+    manual_axes = tuple(
+        a for a, live in ((tp_axis, live_tp), (ep_axis, live_ep)) if live
+    )
 
-    def grad_sum_axes(spec):
-        """Mesh axes to psum a stage-leaf gradient over: every axis whose
-        compute is partitioned but whose storage does NOT already hold
-        distinct per-device shards. fsdp-STORED leaves got their cross-shard
-        sum from the all-gather transpose (psum_scatter); tp-stored leaves
-        own distinct head/mlp shards; replicated leaves need explicit sums
-        over both the data axes and (when stage compute is tensor-
-        partitioned) tp."""
+    def spec_named(spec):
         named = set()
         for part in spec:
             if part is None:
                 continue
             named.update((part,) if isinstance(part, str) else tuple(part))
-        axes = [a for a in data_axes if a not in named]
-        if live_tp and tp_axis not in named:
-            axes.append(tp_axis)
-        return tuple(axes)
+        return named
 
     W = 2 * (n_stages - 1) + 1  # max in-flight stage inputs per device
     last = n_stages - 1
@@ -358,7 +378,10 @@ def pipeline_value_and_grad_1f1b(
 
         def run_stage(p_stored, xin):
             p = param_prepare(p_stored) if param_prepare is not None else p_stored
-            return stage_fn(p, xin)
+            out = stage_fn(p, xin)
+            if aux_weight is None:
+                return out, jnp.float32(0.0)
+            return out  # stage_fn returns (y, aux)
 
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
@@ -375,6 +398,7 @@ def pipeline_value_and_grad_1f1b(
         )
         dx_buf = jnp.zeros((n_micro, *act_shape), jnp.float32)
         loss_acc = jnp.float32(0.0)
+        aux_acc = jnp.float32(0.0)
 
         for t in range(T):  # static unroll: the schedule is compile-time
             # ---- forward half-step: microbatch i_f = t - rank ----
@@ -382,7 +406,8 @@ def pipeline_value_and_grad_1f1b(
             fwd_valid = jnp.logical_and(i_f >= 0, i_f < n_micro)
             feed = micros[min(t, n_micro - 1)]  # rank 0 runs i_f == t (static)
             inp = jnp.where(rank == 0, feed, fwd_carry)
-            y = run_stage(stage_local, inp)
+            y, aux_f = run_stage(stage_local, inp)
+            aux_acc = aux_acc + jnp.where(fwd_valid, aux_f, 0.0)
             # save the stage input for the recompute-backward; invalid
             # windows write to the scratch slot W
             slot = jnp.where(fwd_valid, jnp.clip(i_f, 0, n_micro - 1) % W, W)
@@ -423,24 +448,19 @@ def pipeline_value_and_grad_1f1b(
             dy = jnp.where(rank == last, dy_head.astype(jnp.float32), bwd_carry)
             dy_seed = dy.astype(x_local.dtype)
             _, stage_vjp = jax.vjp(run_stage, stage_local, x_saved)
-            dp_t, dx_t = stage_vjp(dy_seed)
+            # aux cotangent: d(total loss)/d(aux) is the constant aux_weight
+            # (finish_stage's shared scale supplies the 1/(n_micro*n_data))
+            aux_seed = jnp.float32(aux_weight if aux_weight is not None else 0.0)
+            dp_t, dx_t = stage_vjp((dy_seed, aux_seed))
             d_stage = jax.tree_util.tree_map(
                 lambda a, g: a + jnp.where(bwd_valid, g, 0.0), d_stage, dp_t
             )
             dx_t = dx_t.astype(jnp.float32)
-            if live_tp:
-                # Manual-tp transpose bookkeeping (verified numerically):
-                # inside the LOCAL vjp, jax transposes lax.psum to psum —
-                # so with a replicated seed, per-rank dx = (replicated
-                # residual paths)·g + tp·(rank-local weight paths)·g, and
-                # pmean over tp recovers the exact global cotangent
-                # (residual counted once, weight paths summed across
-                # ranks). Done per hop so the backward carry stays
-                # replicated-correct for the next stage. The same transpose
-                # inflates every stage-PARAM cotangent by tp (each param
-                # path crosses exactly one replicated-cotangent psum) —
-                # undone in finish_stage.
-                dx_t = lax.pmean(dx_t, tp_axis)
+            for a in manual_axes:
+                # pmean per hop per manual-collective axis (see the rule at
+                # manual_axes): keeps the backward carry replicated-correct
+                # for the next stage's vjp
+                dx_t = lax.pmean(dx_t, a)
             dx_keep = jnp.where(
                 jnp.logical_and(bwd_valid, rank == 0), dx_t, 0.0
             )
@@ -456,18 +476,29 @@ def pipeline_value_and_grad_1f1b(
         # gradient divides by (n_micro * n_data) exactly once.
         scale = 1.0 / (n_micro * n_data)
         loss = lax.psum(loss_acc, axis) / n_micro  # only last rank added
+        if aux_weight is not None:
+            # every rank's stage contributed aux; same n_micro normalization
+            # as pp_loss_fn's GPipe aux channel
+            loss = loss + aux_weight * lax.psum(aux_acc, axis) / n_micro
         for a in data_axes:
             loss = lax.pmean(loss, a)
 
-        tp_fix = 1.0 / sizes[tp_axis] if live_tp else 1.0
-
         def finish_stage(g, spec, p):
-            # tp_fix: the local-vjp psum transpose inflates stage-param
-            # cotangents by tp (see the dx_t comment); grad_sum_axes then
-            # psums replicated leaves so they sum ranks' true paths
-            g = g * (scale * tp_fix)
-            for a in grad_sum_axes(spec):
-                g = lax.psum(g, a)
+            g = g * scale
+            named = spec_named(spec)
+            # manual-collective axes: /size on sharded storage, pmean on
+            # replicated (the uniform rule at manual_axes). Data axes:
+            # distinct microbatches per shard -> their gradients SUM
+            # (fsdp-STORED leaves already got that sum from the all-gather
+            # transpose's psum_scatter).
+            for a in manual_axes:
+                if a in named:
+                    g = g / sizes[a]
+                else:
+                    g = lax.pmean(g, a)
+            for a in data_axes:
+                if a not in named:
+                    g = lax.psum(g, a)
             # restore the leading stage dim so the global gradient pytree
             # matches the (S, ...) storage layout the optimizer holds
             return g.astype(p.dtype)[None]

@@ -28,14 +28,16 @@ def env():
     cluster.add_tpu_pool("v5p", "v5p", "2x2x4", slices=1)
     agents = {}
     # dim-0 / big-2 are born with degraded visibility (setting it after the
-    # pod starts would race the probe controller's first poll)
-    cluster.add_pod_behavior(
-        sim_agent_behavior(agents, visible_chips={"dim-0": 2, "big-2": 3})
-    )
+    # pod starts would race the probe controller's first poll). The dict is
+    # captured by reference inside the behavior, so tests that need a pod to
+    # be REBORN degraded (host-loss downgrade) mutate visible_chips before
+    # deleting the pod.
+    visible_chips = {"dim-0": 2, "big-2": 3}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, visible_chips=visible_chips))
     config = Config(readiness_probe_period_s=0.2)
     mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
     mgr.start()
-    yield cluster, agents
+    yield cluster, agents, visible_chips
     mgr.stop()
     cluster.stop()
 
@@ -69,7 +71,7 @@ def get_nb(cluster, name):
 def test_partial_chip_visibility_blocks_mesh_ready(env):
     """Pods Ready but one host reports 2/4 chips -> mesh_ready stays false
     and chips_visible reports the true count; full visibility flips it."""
-    cluster, agents = env
+    cluster, agents, _ = env
     cluster.client.create(mk_nb("dim"))  # dim-0 reports 2/4 from birth
     wait_for(
         lambda: get_nb(cluster, "dim").status.ready_replicas == 1,
@@ -101,7 +103,7 @@ def test_partial_chip_visibility_blocks_mesh_ready(env):
 def test_multihost_gate_requires_every_host(env):
     """v5p 2x2x4 = 4 hosts: one degraded host (3/4 chips) holds the whole
     slice; chips_visible aggregates per-host reports (15, not 16)."""
-    cluster, agents = env
+    cluster, agents, _ = env
     cluster.client.create(mk_nb("big", topology="2x2x4", accelerator="v5p"))
     wait_for(
         lambda: get_nb(cluster, "big").status.ready_replicas == 4,
@@ -127,7 +129,7 @@ def test_multihost_gate_requires_every_host(env):
 
 def test_chip_loss_after_ready_revokes_gate_but_keeps_first_ready(env):
     """The heartbeat re-detects chip loss; first_ready_time is immutable."""
-    cluster, agents = env
+    cluster, agents, _ = env
     cluster.client.create(mk_nb("flaky"))
     nb = wait_for(
         lambda: (
@@ -152,7 +154,7 @@ def test_chip_loss_after_ready_revokes_gate_but_keeps_first_ready(env):
 def test_unreachable_probe_keeps_gate_closed(env):
     """No reachable agent (probe-less image): ready pods alone do not open
     the gate — device truth is required."""
-    cluster, agents = env
+    cluster, agents, _ = env
     nb = mk_nb("mute")
     cluster.client.create(nb)
     wait_for(lambda: "mute-0" in agents, msg="agent")
@@ -173,7 +175,7 @@ def test_mesh_ready_downgrades_after_host_loss(env):
     back off (and the chip count drops) even though ready_pods < hosts."""
     from odh_kubeflow_tpu.api.core import Pod
 
-    cluster, agents = env
+    cluster, agents, visible_chips = env
     cluster.client.create(mk_nb("lossy", topology="2x2x4", accelerator="v5p"))
     got = wait_for(
         lambda: (
@@ -183,7 +185,13 @@ def test_mesh_ready_downgrades_after_host_loss(env):
     )
     assert got.status.tpu.chips_visible == 16
 
-    # lose a host: the probe cycle must observe the gap and downgrade
+    # lose a host: the probe cycle must observe the gap and downgrade.
+    # The STS-analog recreates the pod (level-triggered), and a reborn
+    # fully-sighted agent would flip mesh_ready back on — under CPU
+    # contention the 50 ms poll below can miss that transient False window
+    # entirely (the round-4 flake). Degrade the REBORN host's visibility
+    # first so the downgraded state is stable until observed.
+    visible_chips["lossy-2"] = 0
     cluster.client.delete(Pod, NS, "lossy-2")
     wait_for(
         lambda: (
