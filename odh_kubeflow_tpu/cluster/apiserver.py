@@ -241,6 +241,10 @@ class ApiServer:
                 code, body = self._delete(h, route)
             else:
                 raise InvalidError(f"unsupported {method} on {parsed.path!r}")
+            # serialize INSIDE the try: an unserializable value (bad
+            # admission-hook output) must take the 500 path below, not
+            # escape after an "ok" audit record
+            payload = json.dumps(body).encode()
         except ApiError as e:
             self._audit(method, h.path, f"{e.code} {e.reason}")
             self._send_status_error(h, e)
@@ -258,7 +262,7 @@ class ApiServer:
             return
         self._audit(method, h.path, "ok")
         try:
-            self._send_json(h, code, body)
+            respond(h, code, payload)
         except OSError:  # client gone mid-send (incl. TLS aborts)
             pass
 

@@ -10,6 +10,10 @@ webhook injects (tpu/env.py) turns into a live ICI mesh with one call:
     mesh = MeshPlan.auto(len(jax.devices())).build()
 """
 from .distributed import initialize_from_env, slice_mesh_axes
+from .interleaved_1f1b import (
+    build_schedule as build_interleaved_1f1b_schedule,
+    pipeline_value_and_grad_interleaved_1f1b,
+)
 from .pipeline import pipeline_apply, pipeline_value_and_grad_1f1b, stack_stages
 from .mesh import (
     AXES,
@@ -21,8 +25,10 @@ from .mesh import (
 
 __all__ = [
     "AXES",
+    "build_interleaved_1f1b_schedule",
     "pipeline_apply",
     "pipeline_value_and_grad_1f1b",
+    "pipeline_value_and_grad_interleaved_1f1b",
     "stack_stages",
     "MeshPlan",
     "batch_spec",

@@ -1,0 +1,576 @@
+"""Interleaved 1F1B: Megatron's production pipeline schedule, lockstep-SPMD.
+
+Combines the virtual-stage layout (pipeline.py `_pipeline_apply_interleaved`:
+rank r holds v non-adjacent layer chunks, chunk c = global layer group
+c*S + r, ONE ppermute ring) with the 1F1B property (a microbatch's backward
+runs as soon as its last-virtual-stage forward lands, bounding in-flight
+activations at O(S·v), independent of n_micro).
+
+The engine keeps the v=1 1F1B's PAIRED lockstep shape — every step computes
+one (masked) forward AND one (masked) backward chunk visit, with one
+ppermute per direction — because each per-step ring hop is a rendezvous
+over pp: a step costs the max over ranks regardless, so an unpaired
+(one-op-per-step) design would make every steady-state step cost a full
+backward (adjacent ranks alternate F/B phases) and LOSE to plain 1F1B.
+With pairs, wall-clock is T paired chunk-steps against plain 1F1B's
+v*(m + 2(S-1)) chunk-equivalents; Megatron's ordering brings
+T = m*v + (v-1)*S + 2(S-1), a strict win for S > 2 (equal at S = 2) while
+activation memory stays O(S*v). The ASYNC form of the schedule (warmup
+stretches running back-to-back forwards with P2P waits, near-zero idle)
+does not fit a lockstep ring; the paired T above is the honest SPMD cost.
+
+The schedule itself is built in pure Python (`build_schedule`) as static
+tables — per (step, rank): the (microbatch, chunk) of each half-step and
+buffer slots from a linear-scan allocator — then consumed by the traced
+loop via tiny per-step gathers on the traced rank. Dependencies, op
+coverage and buffer bounds are asserted at build time (and unit-tested), so
+the traced engine never encodes scheduling decisions.
+
+Gradient bookkeeping (loss head seeding, constant aux cotangent, the
+per-manual-axis correction rule, normalization) is shared with
+pipeline_value_and_grad_1f1b — see its docstring; parity is pinned by
+tests/test_parallel.py and tests/test_moe.py.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .pipeline import finish_head_grad, finish_stage_grad, wrap_stage_fn
+
+@dataclass
+class Schedule:
+    """Static interleaved-1F1B schedule over T paired steps for (S ranks,
+    v chunks, m microbatches). All tables are (T, S) lists-of-lists of ints;
+    each step holds at most one forward op and one backward op per rank.
+    Slot tables are stored +1 with 0 meaning "none" (the engine maps 0 to
+    the buffer's scratch slot)."""
+
+    S: int
+    v: int
+    m: int
+    T: int
+    f_on: List[List[int]]      # 1 when this (step, rank) runs a forward op
+    f_mb: List[List[int]]      # its microbatch (0 when off)
+    f_chunk: List[List[int]]   # its chunk (0 when off)
+    b_on: List[List[int]]      # 1 when this (step, rank) runs a backward op
+    b_mb: List[List[int]]
+    b_chunk: List[List[int]]
+    in_w: List[List[int]]      # F: save stage input at this in_buf slot (+1)
+    in_r: List[List[int]]      # B: read saved input from this in_buf slot (+1)
+    recvf_w: List[List[int]]   # arrival store slot for the fwd carry (+1)
+    recvf_r: List[List[int]]   # F: read activation from this recv slot (+1)
+    recvb_w: List[List[int]]   # arrival store slot for the bwd carry (+1)
+    recvb_r: List[List[int]]   # B: read cotangent from this recv slot (+1)
+    dyh_w: List[List[int]]     # head F: store dy_head at this slot (+1)
+    dyh_r: List[List[int]]     # last-vstage B: read dy_head from there (+1)
+    in_width: int = 0
+    recvf_width: int = 0
+    recvb_width: int = 0
+    dyh_width: int = 0
+    # schedule quality, for reporting: fraction of per-rank half-slots idle
+    bubble_fraction: float = 0.0
+
+
+def _fwd_order(k: int, S: int, v: int) -> Tuple[int, int]:
+    """k-th forward chunk-op of a rank -> (microbatch, chunk), Megatron's
+    group-of-S sweep (S microbatches through a chunk, then the next chunk)."""
+    grp, p = divmod(k, S * v)
+    return grp * S + p % S, p // S
+
+
+def _bwd_order(k: int, S: int, v: int) -> Tuple[int, int]:
+    """k-th backward chunk-op: same sweep, chunks mirrored (last chunk
+    drains first)."""
+    grp, p = divmod(k, S * v)
+    return grp * S + p % S, v - 1 - p // S
+
+
+class _SlotAlloc:
+    """Linear-scan buffer slot allocator; freed slots become reusable the
+    NEXT step (a same-step write of a just-read slot would clobber under the
+    engine's fixed store-then-compute order)."""
+
+    def __init__(self):
+        self.free: List[int] = []
+        self.freed_at: Dict[int, int] = {}
+        self.width = 0
+
+    def alloc(self, step: int) -> int:
+        for s in list(self.free):
+            if self.freed_at.get(s, -1) < step:
+                self.free.remove(s)
+                return s
+        s = self.width
+        self.width += 1
+        return s
+
+    def release(self, slot: int, step: int) -> None:
+        self.free.append(slot)
+        self.freed_at[slot] = step
+
+
+def build_schedule(S: int, v: int, m: int) -> Schedule:
+    """Greedy in-order assignment of Megatron's interleaved-1F1B op lists to
+    lockstep steps (one chunk-op per rank per step; an op waits until its
+    dependency's result has crossed the ring: dep step + 1)."""
+    if m % S:
+        raise ValueError(
+            f"interleaved 1F1B needs n_micro ({m}) divisible by the stage "
+            f"count ({S})"
+        )
+    total = m * v
+    # Megatron-LM warmup: 2*(S - r - 1) + (v - 1) * S forward chunk-ops
+    # before the first backward, capped at the total
+    ops: Dict[int, List[Tuple[str, int, int]]] = {}
+    for r in range(S):
+        warm = min(2 * (S - r - 1) + (v - 1) * S, total)
+        seq: List[Tuple[str, int, int]] = []
+        for k in range(warm):
+            seq.append(("F", *_fwd_order(k, S, v)))
+        for k in range(warm, total):
+            seq.append(("F", *_fwd_order(k, S, v)))
+            seq.append(("B", *_bwd_order(k - warm, S, v)))
+        for k in range(total - warm, total):
+            seq.append(("B", *_bwd_order(k, S, v)))
+        ops[r] = seq
+
+    def fdep(i: int, c: int, r: int) -> Optional[Tuple[str, int, int, int]]:
+        if r > 0:
+            return ("F", i, c, r - 1)
+        if c > 0:
+            return ("F", i, c - 1, S - 1)
+        return None  # injection
+
+    def bdep(i: int, c: int, r: int) -> Tuple[str, int, int, int]:
+        if c == v - 1 and r == S - 1:
+            return ("F", i, c, r)  # dy_head from its own forward
+        if r < S - 1:
+            return ("B", i, c, r + 1)
+        return ("B", i, c + 1, 0)
+
+    # Greedy paired assignment: the engine executes one (masked) forward
+    # half-step AND one (masked) backward half-step per step — the same
+    # lockstep shape as the v=1 1F1B engine, so a step's cost is constant
+    # and the ring permutes stay one-per-direction-per-step. Each rank
+    # places its next op when the op's dependency result has crossed the
+    # ring (dep step <= t-1), and may place the FOLLOWING op in the same
+    # step when it is of the other kind (the fwd half runs first, so a
+    # last-virtual-stage backward may consume its own same-step forward's
+    # dy_head — the v=1 engine's head pairing).
+    done: Dict[Tuple[str, int, int, int], int] = {}  # op -> step
+    ptr = [0] * S
+    placed_f: List[List[Optional[Tuple[int, int]]]] = []  # (i, c) per rank
+    placed_b: List[List[Optional[Tuple[int, int]]]] = []
+    step = 0
+    guard = 4 * total * S + 8 * S * v + 64
+    while any(ptr[r] < len(ops[r]) for r in range(S)):
+        if step > guard:
+            raise AssertionError("interleaved 1F1B schedule did not converge")
+        row_f: List[Optional[Tuple[int, int]]] = [None] * S
+        row_b: List[Optional[Tuple[int, int]]] = [None] * S
+        for r in range(S):
+            for _try in range(2):  # at most one op of each kind per step
+                if ptr[r] >= len(ops[r]):
+                    break
+                kind, i, c = ops[r][ptr[r]]
+                if kind == "F":
+                    if row_f[r] is not None:
+                        break
+                    dep = fdep(i, c, r)
+                    if dep is not None and done.get(dep, step) >= step:
+                        break
+                    row_f[r] = (i, c)
+                    done[("F", i, c, r)] = step
+                else:
+                    if row_b[r] is not None:
+                        break
+                    dep = bdep(i, c, r)
+                    # same-step allowed only for the head pair (fwd half
+                    # runs before the bwd half)
+                    limit = step if dep[0] == "F" and dep[1:] == (i, c, r) \
+                        else step - 1
+                    if done.get(dep, limit + 1) > limit:
+                        break
+                    row_b[r] = (i, c)
+                    done[("B", i, c, r)] = step
+                ptr[r] += 1
+        placed_f.append(row_f)
+        placed_b.append(row_b)
+        step += 1
+    T = step
+
+    z = [[0] * S for _ in range(T)]
+    sched = Schedule(
+        S=S, v=v, m=m, T=T,
+        f_on=[r[:] for r in z], f_mb=[r[:] for r in z],
+        f_chunk=[r[:] for r in z],
+        b_on=[r[:] for r in z], b_mb=[r[:] for r in z],
+        b_chunk=[r[:] for r in z],
+        in_w=[r[:] for r in z], in_r=[r[:] for r in z],
+        recvf_w=[r[:] for r in z], recvf_r=[r[:] for r in z],
+        recvb_w=[r[:] for r in z], recvb_r=[r[:] for r in z],
+        dyh_w=[r[:] for r in z], dyh_r=[r[:] for r in z],
+    )
+    for t in range(T):
+        for r in range(S):
+            if placed_f[t][r] is not None:
+                sched.f_on[t][r] = 1
+                sched.f_mb[t][r], sched.f_chunk[t][r] = placed_f[t][r]
+            if placed_b[t][r] is not None:
+                sched.b_on[t][r] = 1
+                sched.b_mb[t][r], sched.b_chunk[t][r] = placed_b[t][r]
+
+    # ---- chronological slot assignment: at each step, first store the
+    # arrivals (payloads computed at t-1, keyed by the CONSUMER's (i, c):
+    # the ring wrap advances the fwd chunk by +1 and the bwd chunk by -1),
+    # then the forward op (engine runs the fwd half first), then the
+    # backward op ----
+    in_alloc = [_SlotAlloc() for _ in range(S)]
+    recvf_alloc = [_SlotAlloc() for _ in range(S)]
+    recvb_alloc = [_SlotAlloc() for _ in range(S)]
+    dyh_alloc = [_SlotAlloc() for _ in range(S)]
+    in_slot: Dict[Tuple[int, int, int], int] = {}
+    recvf_slot: Dict[Tuple[int, int, int], int] = {}
+    recvb_slot: Dict[Tuple[int, int, int], int] = {}
+    dyh_slot: Dict[Tuple[int, int], int] = {}
+
+    for t in range(T):
+        if t > 0:
+            for r in range(S):
+                if placed_f[t - 1][r] is not None:
+                    i, c = placed_f[t - 1][r]
+                    if not (c == v - 1 and r == S - 1):
+                        rr = (r + 1) % S
+                        cc = c if r < S - 1 else c + 1
+                        s = recvf_alloc[rr].alloc(t)
+                        recvf_slot[(i, cc, rr)] = s
+                        sched.recvf_w[t][rr] = s + 1  # 0 = no arrival
+                if placed_b[t - 1][r] is not None:
+                    i, c = placed_b[t - 1][r]
+                    if not (c == 0 and r == 0):
+                        rr = (r - 1) % S
+                        cc = c if r > 0 else c - 1
+                        s = recvb_alloc[rr].alloc(t)
+                        recvb_slot[(i, cc, rr)] = s
+                        sched.recvb_w[t][rr] = s + 1
+        for r in range(S):
+            if placed_f[t][r] is not None:
+                i, c = placed_f[t][r]
+                s = in_alloc[r].alloc(t)
+                in_slot[(i, c, r)] = s
+                sched.in_w[t][r] = s + 1
+                if c == 0 and r == 0:
+                    pass  # injection: engine reads micros[i] instead
+                else:
+                    s2 = recvf_slot.pop((i, c, r))
+                    sched.recvf_r[t][r] = s2 + 1
+                    recvf_alloc[r].release(s2, t)
+                if c == v - 1 and r == S - 1:
+                    sd = dyh_alloc[r].alloc(t)
+                    dyh_slot[(i, r)] = sd
+                    sched.dyh_w[t][r] = sd + 1
+        for r in range(S):
+            if placed_b[t][r] is not None:
+                i, c = placed_b[t][r]
+                s = in_slot.pop((i, c, r))
+                sched.in_r[t][r] = s + 1
+                in_alloc[r].release(s, t)
+                if c == v - 1 and r == S - 1:
+                    sd = dyh_slot.pop((i, r))
+                    sched.dyh_r[t][r] = sd + 1
+                    dyh_alloc[r].release(sd, t)
+                else:
+                    s2 = recvb_slot.pop((i, c, r))
+                    sched.recvb_r[t][r] = s2 + 1
+                    recvb_alloc[r].release(s2, t)
+
+    sched.in_width = max(a.width for a in in_alloc) + 1  # +scratch
+    sched.recvf_width = max([a.width for a in recvf_alloc] or [0]) + 1
+    sched.recvb_width = max([a.width for a in recvb_alloc] or [0]) + 1
+    sched.dyh_width = max([a.width for a in dyh_alloc] or [0]) + 1
+    # per rank per step the engine runs one fwd and one bwd half-slot;
+    # useful half-slots are the m*v ops of each kind
+    sched.bubble_fraction = 1.0 - total / float(T)
+    return sched
+
+
+def validate_schedule(sched: Schedule) -> None:
+    """Assert coverage, dependency and buffer-consistency invariants (used
+    by tests and the build)."""
+    S, v, m, T = sched.S, sched.v, sched.m, sched.T
+    seen_f: Dict[Tuple[int, int, int], int] = {}
+    seen_b: Dict[Tuple[int, int, int], int] = {}
+    for t in range(T):
+        for r in range(S):
+            if sched.f_on[t][r]:
+                key = (sched.f_mb[t][r], sched.f_chunk[t][r], r)
+                assert key not in seen_f, f"duplicate F {key}"
+                seen_f[key] = t
+            if sched.b_on[t][r]:
+                key = (sched.b_mb[t][r], sched.b_chunk[t][r], r)
+                assert key not in seen_b, f"duplicate B {key}"
+                seen_b[key] = t
+    assert len(seen_f) == m * v * S, "missing forward ops"
+    assert len(seen_b) == m * v * S, "missing backward ops"
+    for (i, c, r), t in seen_f.items():
+        if r > 0:
+            assert seen_f[(i, c, r - 1)] < t, f"F dep violated at {(i, c, r)}"
+        elif c > 0:
+            assert seen_f[(i, c - 1, S - 1)] < t, f"F wrap dep at {(i, c, r)}"
+    for (i, c, r), t in seen_b.items():
+        if c == v - 1 and r == S - 1:
+            # seeds from its own forward's dy_head; same step is legal
+            # (the engine's fwd half runs first)
+            assert seen_f[(i, c, r)] <= t, f"head pair order at {(i, c, r)}"
+            continue
+        assert seen_f[(i, c, r)] < t, f"B before its own F at {(i, c, r)}"
+        succ = (i, c, r + 1) if r < S - 1 else (i, c + 1, 0)
+        assert seen_b[succ] < t, f"B dep violated at {(i, c, r)}"
+
+
+def pipeline_value_and_grad_interleaved_1f1b(
+    stage_fn: Callable[[Any, jnp.ndarray], Any],
+    loss_head: Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    head_params: Any,
+    x: jnp.ndarray,
+    targets: jnp.ndarray,
+    mesh,
+    n_micro: int,
+    n_chunks: int,
+    axis: str = "pp",
+    param_specs: Any = None,
+    param_prepare: Optional[Callable[[Any], Any]] = None,
+    tp_axis: str = "",
+    aux_weight: Optional[float] = None,
+    ep_axis: str = "",
+):
+    """Interleaved 1F1B: loss and gradients in one pass over the virtual-
+    stage layout. stage_params leaves are (S, v, Lg, ...) — `to_pp_params`
+    with n_chunks=v — and stage_fn consumes ONE chunk's params (Lg, ...).
+    Everything else (loss_head contract, aux_weight, tp/ep corrections,
+    returned pytree shapes) matches pipeline_value_and_grad_1f1b."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_stages = sizes[axis]
+    if n_stages == 1:
+        raise ValueError("interleaved 1F1B needs pp > 1")
+    sched = build_schedule(n_stages, n_chunks, n_micro)
+    data_axes = tuple(a for a in ("dp", "fsdp") if sizes.get(a, 1) > 1)
+    n_data = math.prod(sizes[a] for a in data_axes) if data_axes else 1
+    local_batch = x.shape[0] // max(1, n_data)
+    if local_batch % n_micro:
+        raise ValueError(
+            f"per-data-shard batch {local_batch} not divisible by n_micro {n_micro}"
+        )
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    live_tp = tp_axis and sizes.get(tp_axis, 1) > 1
+    live_ep = ep_axis and sizes.get(ep_axis, 1) > 1
+    manual_axes = tuple(
+        a for a, live in ((tp_axis, live_tp), (ep_axis, live_ep)) if live
+    )
+    last = n_stages - 1
+    T = sched.T
+    # (T, S) tables -> jnp constants, gathered per step by the traced rank
+    tab = {
+        name: jnp.asarray(getattr(sched, name), jnp.int32)
+        for name in (
+            "f_on", "f_mb", "f_chunk", "b_on", "b_mb", "b_chunk",
+            "in_w", "in_r", "recvf_w", "recvf_r", "recvb_w", "recvb_r",
+            "dyh_w", "dyh_r",
+        )
+    }
+
+    def per_device(stage_params, head_params, x_local, tgt_local):
+        stage_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        rank = lax.axis_index(axis)
+        batch = x_local.shape[0]
+        mb = batch // n_micro
+        micros = x_local.reshape(n_micro, mb, *x_local.shape[1:])
+        tgt_micros = tgt_local.reshape(n_micro, mb, *tgt_local.shape[1:])
+        act_shape = (mb, *x_local.shape[1:])
+
+        def row(name, t):
+            return tab[name][t][rank]
+
+        def slot_of(raw, width):
+            # +1-encoded table value -> buffer slot (0 = scratch)
+            return jnp.where(raw > 0, raw - 1, width - 1)
+
+        run_chunk = wrap_stage_fn(stage_fn, param_prepare, aux_weight)
+
+        def pick_chunk(c):
+            return jax.tree_util.tree_map(
+                lambda q: lax.dynamic_index_in_dim(q, c, 0, keepdims=False),
+                stage_local,
+            )
+
+        fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+
+        fwd_carry = jnp.zeros(act_shape, x_local.dtype)
+        bwd_carry = jnp.zeros(act_shape, jnp.float32)
+        in_buf = jnp.zeros((sched.in_width, *act_shape), x_local.dtype)
+        recvf_buf = jnp.zeros((sched.recvf_width, *act_shape), x_local.dtype)
+        recvb_buf = jnp.zeros((sched.recvb_width, *act_shape), jnp.float32)
+        dyh_buf = jnp.zeros((sched.dyh_width, *act_shape), jnp.float32)
+        d_stage = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), stage_local
+        )
+        d_head = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), head_params
+        )
+        dx_buf = jnp.zeros((n_micro, *act_shape), jnp.float32)
+        loss_acc = jnp.float32(0.0)
+        aux_acc = jnp.float32(0.0)
+
+        for t in range(T):  # static unroll: the schedule is compile-time
+            # ---- arrivals: last step's ring payloads into receive slots
+            # (garbage payloads land in the scratch slot per the tables) ----
+            recvf_buf = lax.dynamic_update_index_in_dim(
+                recvf_buf, fwd_carry,
+                slot_of(row("recvf_w", t), sched.recvf_width), 0,
+            )
+            recvb_buf = lax.dynamic_update_index_in_dim(
+                recvb_buf, bwd_carry,
+                slot_of(row("recvb_w", t), sched.recvb_width), 0,
+            )
+
+            # ---- forward half-step ----
+            f_on = row("f_on", t) > 0
+            i_f = row("f_mb", t)
+            c_f = row("f_chunk", t)
+            chunk_p = pick_chunk(c_f)
+            fresh = lax.dynamic_index_in_dim(micros, i_f, 0, keepdims=False)
+            from_ring = lax.dynamic_index_in_dim(
+                recvf_buf, slot_of(row("recvf_r", t), sched.recvf_width),
+                0, keepdims=False,
+            )
+            inject = jnp.logical_and(rank == 0, c_f == 0)
+            inp = jnp.where(inject, fresh, from_ring)
+            y, aux_f = run_chunk(chunk_p, inp)
+            aux_acc = aux_acc + jnp.where(f_on, aux_f, 0.0)
+            in_buf = lax.dynamic_update_index_in_dim(
+                in_buf, inp, slot_of(row("in_w", t), sched.in_width), 0
+            )
+
+            # ---- loss head: forward of the LAST virtual stage seeds its
+            # backward's cotangent (read later from dyh_buf) ----
+            tgt = lax.dynamic_index_in_dim(tgt_micros, i_f, 0, keepdims=False)
+
+            def _head_run():
+                loss_t, head_vjp = jax.vjp(
+                    lambda hp, yy: loss_head(hp, yy, tgt), head_params, y
+                )
+                dhp_t, dy_head = head_vjp(jnp.float32(1.0))
+                return loss_t, dhp_t, dy_head
+
+            def _head_skip():
+                return (
+                    jnp.float32(0.0),
+                    jax.tree_util.tree_map(jnp.zeros_like, head_params),
+                    jnp.zeros_like(y),
+                )
+
+            is_head = jnp.logical_and(
+                f_on, jnp.logical_and(rank == last, c_f == n_chunks - 1)
+            )
+            loss_t, dhp_t, dy_head = lax.cond(is_head, _head_run, _head_skip)
+            loss_acc = loss_acc + jnp.where(is_head, loss_t, 0.0)
+            d_head = jax.tree_util.tree_map(
+                lambda a, g: a + jnp.where(is_head, g, 0.0), d_head, dhp_t
+            )
+            dyh_buf = lax.dynamic_update_index_in_dim(
+                dyh_buf, dy_head.astype(jnp.float32),
+                slot_of(row("dyh_w", t), sched.dyh_width), 0,
+            )
+
+            # ---- backward half-step ----
+            b_on = row("b_on", t) > 0
+            i_b = row("b_mb", t)
+            c_b = row("b_chunk", t)
+            chunk_pb = pick_chunk(c_b)
+            x_saved = lax.dynamic_index_in_dim(
+                in_buf, slot_of(row("in_r", t), sched.in_width), 0,
+                keepdims=False,
+            )
+            dy_ring = lax.dynamic_index_in_dim(
+                recvb_buf, slot_of(row("recvb_r", t), sched.recvb_width),
+                0, keepdims=False,
+            )
+            dy_saved = lax.dynamic_index_in_dim(
+                dyh_buf, slot_of(row("dyh_r", t), sched.dyh_width),
+                0, keepdims=False,
+            )
+            is_lastv = jnp.logical_and(rank == last, c_b == n_chunks - 1)
+            dy = jnp.where(is_lastv, dy_saved, dy_ring)
+            aux_seed = jnp.float32(aux_weight if aux_weight is not None else 0.0)
+            _, chunk_vjp = jax.vjp(run_chunk, chunk_pb, x_saved)
+            dp_t, dx_t = chunk_vjp((dy.astype(x_local.dtype), aux_seed))
+            d_stage = jax.tree_util.tree_map(
+                lambda acc, g: lax.dynamic_update_index_in_dim(
+                    acc,
+                    lax.dynamic_index_in_dim(acc, c_b, 0, keepdims=False)
+                    + jnp.where(b_on, g, 0.0),
+                    c_b, 0,
+                ),
+                d_stage, dp_t,
+            )
+            dx_t = dx_t.astype(jnp.float32)
+            for a in manual_axes:
+                dx_t = lax.pmean(dx_t, a)
+            dx_keep = jnp.where(
+                jnp.logical_and(
+                    b_on, jnp.logical_and(rank == 0, c_b == 0)
+                ),
+                dx_t, 0.0,
+            )
+            dx_buf = dx_buf.at[jnp.clip(i_b, 0, n_micro - 1)].add(dx_keep)
+
+            # ---- ring hops ----
+            fwd_carry = lax.ppermute(y, axis, fwd_perm)
+            bwd_carry = lax.ppermute(dx_t, axis, bwd_perm)
+
+        # ---- normalization + cross-device reductions (the v=1 rule) ----
+        scale = 1.0 / (n_micro * n_data)
+        loss = lax.psum(loss_acc, axis) / n_micro
+        if aux_weight is not None:
+            loss = loss + aux_weight * lax.psum(aux_acc, axis) / n_micro
+        for a in data_axes:
+            loss = lax.pmean(loss, a)
+
+        d_stage = jax.tree_util.tree_map(
+            lambda g, spec, p: finish_stage_grad(
+                g, spec, p, scale=scale, sizes=sizes,
+                manual_axes=manual_axes, data_axes=data_axes,
+            ),
+            d_stage, param_specs, stage_local,
+        )
+        d_head = jax.tree_util.tree_map(
+            lambda g, p: finish_head_grad(
+                g, p, scale=scale, axis=axis, data_axes=data_axes
+            ),
+            d_head, head_params,
+        )
+
+        dx = dx_buf.reshape(batch, *x_local.shape[1:]) * scale
+        dx = lax.psum(dx, axis)  # only rank 0 chunk 0 contributed
+        return loss, d_stage, d_head, dx.astype(x_local.dtype)
+
+    x_spec = P(data_axes if data_axes else None)
+    head_rep_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
+    out_specs = (P(), param_specs, head_rep_specs, x_spec)
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(param_specs, head_rep_specs, x_spec, x_spec),
+        out_specs=out_specs,
+        check_vma=False,
+    )(stage_params, head_params, x, targets)

@@ -266,6 +266,72 @@ def stack_stages(layer_params: Any, n_stages: int, n_chunks: int = 1) -> Any:
     return jax.tree_util.tree_map(reshape, layer_params)
 
 
+def spec_named(spec) -> set:
+    """Mesh axis names appearing in a PartitionSpec (the leaf's STORAGE
+    axes)."""
+    named = set()
+    for part in spec:
+        if part is None:
+            continue
+        named.update((part,) if isinstance(part, str) else tuple(part))
+    return named
+
+
+def finish_stage_grad(g, spec, p, *, scale, sizes, manual_axes, data_axes):
+    """The shared 1F1B gradient finisher (both engines). Per MANUAL-
+    collective axis a (tp row-parallel psums, the MoE ep combine psum), the
+    local-vjp transpose rule (psum -> psum, verified numerically) makes the
+    per-rank cotangent of any value = (replicated paths) +
+    size * (own-rank-only paths through a's psum). Hence:
+
+    - leaf STORED sharded on a (distinct shards): its true gradient is
+      exactly the own-rank paths, each crossing a's psum once -> / size;
+    - leaf replicated over a: pmean over a is exact for BOTH path kinds
+      (replicated paths average to themselves; size*own_r paths pmean to
+      sum_r own_r);
+
+    Data axes hold distinct microbatches, so their gradients SUM
+    (fsdp-STORED leaves already got that sum from the all-gather
+    transpose's psum_scatter). The leading [None] restores the stage dim so
+    the global gradient pytree matches the (S, ...) storage layout."""
+    g = g * scale
+    named = spec_named(spec)
+    for a in manual_axes:
+        if a in named:
+            g = g / sizes[a]
+        else:
+            g = lax.pmean(g, a)
+    for a in data_axes:
+        if a not in named:
+            g = lax.psum(g, a)
+    return g.astype(p.dtype)[None]
+
+
+def finish_head_grad(g, p, *, scale, axis, data_axes):
+    """Head-param finisher: head compute is replicated over the manual
+    axes (no correction needed); only the last pp stage contributed."""
+    g = g * scale
+    for a in data_axes:
+        g = lax.psum(g, a)
+    g = lax.psum(g, axis)
+    return g.astype(p.dtype)
+
+
+def wrap_stage_fn(stage_fn, param_prepare, aux_weight):
+    """Per-visit stage runner shared by both 1F1B engines: applies the
+    ZeRO prepare hook inside the vjp (so its transpose reduce-scatters) and
+    normalizes the output to (y, aux)."""
+
+    def run_stage(p_stored, xin):
+        p = param_prepare(p_stored) if param_prepare is not None else p_stored
+        out = stage_fn(p, xin)
+        if aux_weight is None:
+            return out, jnp.float32(0.0)
+        return out  # stage_fn returns (y, aux)
+
+    return run_stage
+
+
 def pipeline_value_and_grad_1f1b(
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     loss_head: Callable[[Any, jnp.ndarray, jnp.ndarray], jnp.ndarray],
@@ -342,27 +408,12 @@ def pipeline_value_and_grad_1f1b(
     live_tp = tp_axis and sizes.get(tp_axis, 1) > 1
     live_ep = ep_axis and sizes.get(ep_axis, 1) > 1
     # Axes with MANUAL collectives inside the stage (tp: row-parallel
-    # psums; ep: the MoE combine psum). The local-vjp transpose rule
-    # (psum -> psum, verified numerically) makes the per-rank cotangent of
-    # any value = (replicated paths) + size * (own-rank-only paths through
-    # that axis's psum). Hence the uniform correction per axis a:
-    #   - leaf STORED sharded on a (distinct shards): its true gradient is
-    #     exactly the own-rank paths, each crossing a's psum once -> / size;
-    #   - leaf replicated over a: pmean over a is exact for BOTH path kinds
-    #     (replicated paths average to themselves; size*own_r paths
-    #     pmean to sum_r own_r);
-    #   - dx (replicated activations): pmean per hop, same argument.
+    # psums; ep: the MoE combine psum) — the per-leaf /size-or-pmean
+    # correction rule and its derivation live on finish_stage_grad; dx
+    # (replicated activations) takes a pmean per hop by the same argument.
     manual_axes = tuple(
         a for a, live in ((tp_axis, live_tp), (ep_axis, live_ep)) if live
     )
-
-    def spec_named(spec):
-        named = set()
-        for part in spec:
-            if part is None:
-                continue
-            named.update((part,) if isinstance(part, str) else tuple(part))
-        return named
 
     W = 2 * (n_stages - 1) + 1  # max in-flight stage inputs per device
     last = n_stages - 1
@@ -376,12 +427,7 @@ def pipeline_value_and_grad_1f1b(
         micros = x_local.reshape(n_micro, mb, *x_local.shape[1:])
         tgt_micros = tgt_local.reshape(n_micro, mb, *tgt_local.shape[1:])
 
-        def run_stage(p_stored, xin):
-            p = param_prepare(p_stored) if param_prepare is not None else p_stored
-            out = stage_fn(p, xin)
-            if aux_weight is None:
-                return out, jnp.float32(0.0)
-            return out  # stage_fn returns (y, aux)
+        run_stage = wrap_stage_fn(stage_fn, param_prepare, aux_weight)
 
         fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
         bwd_perm = [(i, (i - 1) % n_stages) for i in range(n_stages)]
@@ -483,38 +529,19 @@ def pipeline_value_and_grad_1f1b(
         for a in data_axes:
             loss = lax.pmean(loss, a)
 
-        def finish_stage(g, spec, p):
-            g = g * scale
-            named = spec_named(spec)
-            # manual-collective axes: /size on sharded storage, pmean on
-            # replicated (the uniform rule at manual_axes). Data axes:
-            # distinct microbatches per shard -> their gradients SUM
-            # (fsdp-STORED leaves already got that sum from the all-gather
-            # transpose's psum_scatter).
-            for a in manual_axes:
-                if a in named:
-                    g = g / sizes[a]
-                else:
-                    g = lax.pmean(g, a)
-            for a in data_axes:
-                if a not in named:
-                    g = lax.psum(g, a)
-            # restore the leading stage dim so the global gradient pytree
-            # matches the (S, ...) storage layout the optimizer holds
-            return g.astype(p.dtype)[None]
-
         d_stage = jax.tree_util.tree_map(
-            finish_stage, d_stage, param_specs, stage_local
+            lambda g, spec, p: finish_stage_grad(
+                g, spec, p, scale=scale, sizes=sizes,
+                manual_axes=manual_axes, data_axes=data_axes,
+            ),
+            d_stage, param_specs, stage_local,
         )
-
-        def finish_head(g, p):
-            g = g * scale
-            for a in data_axes:
-                g = lax.psum(g, a)
-            g = lax.psum(g, axis)  # only the last stage contributed
-            return g.astype(p.dtype)
-
-        d_head = jax.tree_util.tree_map(finish_head, d_head, head_params)
+        d_head = jax.tree_util.tree_map(
+            lambda g, p: finish_head_grad(
+                g, p, scale=scale, axis=axis, data_axes=data_axes
+            ),
+            d_head, head_params,
+        )
 
         dx = dx_buf.reshape(batch, *x_local.shape[1:]) * scale
         dx = lax.psum(dx, axis)  # only rank 0 contributed; tp-correct already

@@ -353,3 +353,62 @@ def test_pp_moe_1f1b_parity():
             )
         # the aux channel really reaches the router through 1F1B
         assert float(jnp.sum(jnp.abs(f_grads["layers"]["router"]))) > 0
+
+
+def test_pp_moe_interleaved_1f1b_parity():
+    """The full composition: Megatron interleaved 1F1B (pp=2 x v=2) with
+    ep-sharded MoE experts inside the chunks and the aux channel threaded —
+    loss and gradients match interleaved GPipe."""
+    from jax.sharding import NamedSharding
+
+    from odh_kubeflow_tpu.models import (
+        pp_loss_fn,
+        pp_param_specs,
+        to_pp_params,
+    )
+    from odh_kubeflow_tpu.models.transformer import pp_1f1b_value_and_grad
+
+    cfg = TransformerConfig(
+        vocab=64,
+        d_model=32,
+        n_layers=8,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=64,
+        dtype=jnp.float32,
+        use_flash=False,
+        remat=False,
+        moe=MoEConfig(n_experts=4, experts_per_token=2, capacity_factor=8.0),
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+
+    plan = MeshPlan(pp=2, ep=2, dp=2)
+    mesh = plan.build(jax.devices()[:8])
+    pp_params = to_pp_params(params, 2, cfg, mesh, n_chunks=2)
+    specs = pp_param_specs(cfg, mesh, 2, n_chunks=2)
+    pp_params = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), pp_params, specs
+    )
+    batch = shard_batch(mesh, {"tokens": tokens})
+
+    g_loss, g_grads = jax.jit(jax.value_and_grad(
+        lambda p: pp_loss_fn(p, batch, cfg, mesh, n_micro=4, n_chunks=2)
+    ))(pp_params)
+    f_loss, f_grads = jax.jit(
+        lambda p, b: pp_1f1b_value_and_grad(
+            p, b, cfg, mesh, n_micro=4, n_chunks=2
+        )
+    )(pp_params, batch)
+    jax.block_until_ready(f_loss)
+
+    assert np.allclose(float(f_loss), float(g_loss), atol=1e-6)
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(g_grads)
+    flat_f, _ = jax.tree_util.tree_flatten_with_path(f_grads)
+    for (path_g, a), (path_f, b) in zip(flat_g, flat_f):
+        assert path_g == path_f
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-6, rtol=1e-5,
+            err_msg=jax.tree_util.keystr(path_g),
+        )
+    assert float(jnp.sum(jnp.abs(f_grads["layers"]["router"]))) > 0
