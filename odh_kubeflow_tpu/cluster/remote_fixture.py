@@ -101,8 +101,13 @@ def build_remote_stack(
     webhook_remote = RemoteStore(
         api.base_url, token=token, ca_file=ca, timeout=30, qps=0
     )
+    # TTL read memo: the chain's 3-4 per-ns ConfigMap lookups (mostly 404s)
+    # must not cost wire round-trips per AdmissionReview under a storm
+    from ..runtime.cached_client import TTLReadClient
+
     webhook_server.register(
-        "/mutate-notebook-v1", NotebookWebhook(Client(webhook_remote), config).handle
+        "/mutate-notebook-v1",
+        NotebookWebhook(TTLReadClient(Client(webhook_remote)), config).handle,
     )
     cfg = MutatingWebhookConfiguration()
     cfg.metadata.name = "notebook-mutator"

@@ -293,7 +293,18 @@ class NotebookReconciler:
             )):
                 return
         except NotFoundError:
-            pass
+            # cache-absent -> straight create, skipping the fresh-read
+            # attempt (first reconcile of every notebook; a stale cache
+            # lands in AlreadyExists and falls through to the RMW)
+            try:
+                self.client.create(desired)
+                self.metrics.notebook_create_total.inc()
+                return
+            except AlreadyExistsError:
+                pass
+            except Exception:
+                self.metrics.notebook_create_failed_total.inc()
+                raise
 
         def attempt():
             try:
@@ -346,7 +357,11 @@ class NotebookReconciler:
             )):
                 return
         except NotFoundError:
-            pass
+            try:
+                self.client.create(desired)
+                return
+            except AlreadyExistsError:
+                pass  # stale cache or racing reconcile: RMW below
 
         def attempt():
             try:

@@ -63,6 +63,7 @@ def serve_webhook(client, config: Config, cert_dir: str, port: int = 8443):
     the kubernetes.io/tls Secret layout) — the remote-mode admission path."""
     import os
 
+    from .runtime.cached_client import TTLReadClient
     from .runtime.webhook_server import WebhookServer
 
     server = WebhookServer(
@@ -71,7 +72,11 @@ def serve_webhook(client, config: Config, cert_dir: str, port: int = 8443):
         certfile=os.path.join(cert_dir, "tls.crt"),
         keyfile=os.path.join(cert_dir, "tls.key"),
     )
-    server.register("/mutate-notebook-v1", NotebookWebhook(client, config).handle)
+    # TTL read memo over the webhook's dedicated client: admission reads the
+    # same per-ns ConfigMaps every review; see TTLReadClient
+    server.register(
+        "/mutate-notebook-v1", NotebookWebhook(TTLReadClient(client), config).handle
+    )
     return server.start()
 
 

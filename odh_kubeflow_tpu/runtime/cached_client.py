@@ -16,7 +16,9 @@ what the `api_reader` (uncached Client) is for.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Type
+import threading
+import time
+from typing import Dict, List, Optional, Tuple, Type
 
 from ..apimachinery import KubeObject, NotFoundError, Scheme, default_scheme
 from ..cluster.client import Client, T
@@ -74,3 +76,135 @@ class CachedClient(Client):
             self._decode(cls, obj)
             for obj in inf.list(namespace=namespace, labels=labels)
         ]
+
+
+class TTLReadClient(Client):
+    """Short-TTL read memo over an uncached Client — the admission webhook's
+    cache where no informer registry is reachable (the webhook server runs
+    with its OWN unthrottled client, reference-style; see
+    cluster/remote_fixture.py).
+
+    The webhook chain re-reads the same 3-4 per-namespace ConfigMaps (image
+    catalog, CA bundle, runtime-image sources, proxy env) on EVERY
+    AdmissionReview; under a create storm that is 3 apiserver round-trips per
+    admission, nearly all answering 404 (round-5 loadtest: 240 of ~1000
+    requests). NEGATIVE results are memoized too — the absent-ConfigMap case
+    is the common one. Staleness is bounded by ttl_s and self-heals: the
+    extension reconciler re-syncs the same objects level-triggered, and its
+    CA-source watch re-triggers affected notebooks.
+
+    Writes pass through and invalidate the touched key, so the webhook's own
+    sync writes (runtime-images catalog) never serve themselves stale."""
+
+    # expired-entry sweep threshold: prevents monotonic memo growth across
+    # namespace churn in a long-lived webhook process
+    MAX_ENTRIES = 512
+
+    def __init__(self, inner: Client, ttl_s: float = 2.0):
+        super().__init__(inner.store, inner.scheme)
+        self._inner = inner
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._get_memo: Dict[Tuple, Tuple[float, Optional[dict]]] = {}
+        self._list_memo: Dict[Tuple, Tuple[float, List[dict]]] = {}
+
+    @property
+    def fresh(self) -> Client:
+        """The unmemoized inner client — the read side every write decision
+        must use (see sync_runtime_images' read/write split)."""
+        return self._inner
+
+    def _key(self, cls, namespace, name):
+        av, kind = self._av_kind(cls)
+        return (av, kind, namespace, name)
+
+    def _prune(self, memo: Dict, now: float) -> None:
+        # call with self._lock held
+        if len(memo) < self.MAX_ENTRIES:
+            return
+        for k in [k for k, (ts, _) in memo.items() if now - ts >= self.ttl_s]:
+            del memo[k]
+        if len(memo) >= self.MAX_ENTRIES:  # all live: drop everything (rare)
+            memo.clear()
+
+    def get(self, cls: Type[T], namespace: str, name: str) -> T:
+        key = self._key(cls, namespace, name)
+        now = time.monotonic()
+        with self._lock:
+            hit = self._get_memo.get(key)
+            if hit is not None and now - hit[0] < self.ttl_s:
+                if hit[1] is None:
+                    raise NotFoundError(f"{key[1]} {namespace}/{name} not found (ttl)")
+                return self._decode(cls, hit[1])
+        try:
+            obj = self._inner.get(cls, namespace, name)
+        except NotFoundError:
+            with self._lock:
+                self._prune(self._get_memo, now)
+                self._get_memo[key] = (now, None)
+            raise
+        with self._lock:
+            self._prune(self._get_memo, now)
+            self._get_memo[key] = (now, obj.to_dict())
+        return obj
+
+    def list(
+        self,
+        cls: Type[T],
+        namespace: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> List[T]:
+        av, kind = self._av_kind(cls)
+        key = (av, kind, namespace, tuple(sorted((labels or {}).items())))
+        now = time.monotonic()
+        with self._lock:
+            hit = self._list_memo.get(key)
+            if hit is not None and now - hit[0] < self.ttl_s:
+                return [self._decode(cls, o) for o in hit[1]]
+        out = self._inner.list(cls, namespace=namespace, labels=labels)
+        with self._lock:
+            self._prune(self._list_memo, now)
+            self._list_memo[key] = (now, [o.to_dict() for o in out])
+        return out
+
+    def _invalidate(self, obj) -> None:
+        meta = obj.metadata
+        key = self._key(type(obj), meta.namespace, meta.name)
+        with self._lock:
+            self._get_memo.pop(key, None)
+            self._list_memo.clear()  # lists are cheap to refill; stay correct
+
+    def create(self, obj):
+        out = self._inner.create(obj)
+        self._invalidate(obj)
+        return out
+
+    def update(self, obj):
+        out = self._inner.update(obj)
+        self._invalidate(obj)
+        return out
+
+    def delete(self, cls: Type[T], namespace: str, name: str) -> None:
+        self._inner.delete(cls, namespace, name)
+        with self._lock:
+            self._get_memo.pop(self._key(cls, namespace, name), None)
+            self._list_memo.clear()
+
+    def patch(self, cls: Type[T], namespace: str, name: str, patch: dict) -> T:
+        out = self._inner.patch(cls, namespace, name, patch)
+        with self._lock:
+            self._get_memo.pop(self._key(cls, namespace, name), None)
+            self._list_memo.clear()
+        return out
+
+    def update_status(self, obj):
+        out = self._inner.update_status(obj)
+        self._invalidate(obj)
+        return out
+
+    def patch_status(self, cls: Type[T], namespace: str, name: str, patch: dict) -> T:
+        out = self._inner.patch_status(cls, namespace, name, patch)
+        with self._lock:
+            self._get_memo.pop(self._key(cls, namespace, name), None)
+            self._list_memo.clear()
+        return out
