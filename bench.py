@@ -400,6 +400,15 @@ def bench_moe_train_step():
     # per-step dispatch time: L layers, fwd + ~2x bwd
     dispatch_share = 3 * cfg.n_layers * t_disp / step_s
 
+    # dense-vs-indexed dispatch A/B at the bench token count (VERDICT r4
+    # #7): the dense one-hot einsums are what cfg.dispatch="dense" would run
+    # on a live ep axis; the indexed path is the shipped default
+    # (_moe_ffn_ep_indexed). Timed here single-chip at b*s = 16k tokens.
+    t_disp_dense = _bench_slope(
+        lambda x: dispatch_only(x, moe_params, cfg.moe_resolved, dense=True),
+        (x_tokens,), fetch, n2=20,
+    )
+
     stats = routing_stats(x_tokens, moe_params, cfg.moe_resolved)
     return {
         "tokens_per_s": round(tokens_per_s),
@@ -408,6 +417,14 @@ def bench_moe_train_step():
         "params_active_m": round(n_active / 1e6, 1),
         "mfu_est_active": round(mfu, 3),
         "dispatch_share_est": round(dispatch_share, 3),
+        "dispatch_paths_16k_tokens": {
+            "indexed_ms": round(t_disp * 1e3, 3),
+            "dense_ms": round(t_disp_dense * 1e3, 3),
+            "dense_over_indexed": round(t_disp_dense / max(t_disp, 1e-9), 2),
+            "note": "indexed is the live-ep GSPMD path "
+                    "(models/moe._moe_ffn_ep_indexed); dense kept as "
+                    "cfg.dispatch='dense' for A/B",
+        },
         "capacity_drop_rate": round(float(stats["drop_rate"]), 4),
         "final_loss": round(float(loss), 3),
         "n_experts": cfg.moe.n_experts,
@@ -531,6 +548,91 @@ def _decode_point(cfg, batch, prompt_len, max_new, short_new, max_seq):
 # ---------------------------------------------------------------------------
 
 
+def bench_ring_balance():
+    """Static ring load-balance tables (VERDICT r4 #8) — no hardware: the
+    per-rank block-unit counts from the chunk-id classification the kernels
+    switch on (ops/ring_attention.ring_balance_report)."""
+    from odh_kubeflow_tpu.ops.ring_attention import ring_balance_report
+
+    out = {}
+    for sp in (4, 8):
+        cont = ring_balance_report(sp, "contiguous")
+        zz = ring_balance_report(sp, "zigzag")
+        out[f"sp{sp}"] = {
+            "contiguous_per_rank_units": cont["per_rank_total_units"],
+            "zigzag_per_rank_units": zz["per_rank_total_units"],
+            "contiguous_balance_ratio": round(cont["balance_ratio"], 4),
+            "zigzag_balance_ratio": round(zz["balance_ratio"], 4),
+            "lockstep_wall_units": {
+                "contiguous": cont["lockstep_wall_units"],
+                "zigzag": zz["lockstep_wall_units"],
+            },
+        }
+    return out
+
+
+def bench_flash_block_overhead():
+    """The zigzag ring's per-visit unit (flash_block_with_lse pairs + the
+    (out, lse) merge) vs the plain fused causal kernel at equal total
+    shapes — the single-chip overhead the ring pays for composability
+    (VERDICT r4 #8's on-chip half)."""
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.ops.attention import flash_attention
+    from odh_kubeflow_tpu.ops.ring_attention import flash_block_with_lse
+
+    def fetch(x):
+        float(jnp.sum(x.astype(jnp.float32)))
+
+    key = jax.random.PRNGKey(0)
+    b, s, h, d = 4, 4096, 16, 64
+    chunk = s // 2
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.bfloat16)
+
+    import functools
+
+    t_plain = _bench_slope(
+        functools.partial(flash_attention, causal=True), (q, k, v), fetch, n2=40
+    )
+
+    # one zigzag-style visit over the same tokens: chunk pairs with the
+    # (out, lse) merge — (qa: causal on ka) + (qb: full on ka, causal on kb).
+    # The carry is the full q, so the loop body depends on the whole visit.
+    ka, kb = k[:, :chunk], k[:, chunk:]
+    va, vb = v[:, :chunk], v[:, chunk:]
+
+    def merge(o1, l1, o2, l2):
+        m = jnp.maximum(l1, l2)
+        w1 = jnp.exp(l1 - m)[..., None]
+        w2 = jnp.exp(l2 - m)[..., None]
+        return (o1 * w1 + o2 * w2) / (w1 + w2)
+
+    def visit(qfull, ka, kb, va, vb):
+        qa, qb = qfull[:, :chunk], qfull[:, chunk:]
+        o1, _l1 = flash_block_with_lse(qa, ka, va, True, False)
+        o2, l2 = flash_block_with_lse(qb, ka, va, False, False)
+        o3, l3 = flash_block_with_lse(qb, kb, vb, True, False)
+        bot = merge(o2.astype(jnp.float32), l2, o3.astype(jnp.float32), l3)
+        return jnp.concatenate(
+            [o1.astype(jnp.float32), bot], axis=1
+        ).astype(qfull.dtype)
+
+    t_blocks = _bench_slope(visit, (q, ka, kb, va, vb), fetch, n2=40)
+    return {
+        "shape": f"b{b} s{s} h{h} d{d} (chunk {chunk})",
+        "plain_causal_ms": round(t_plain * 1e3, 4),
+        "block_visit_ms": round(t_blocks * 1e3, 4),
+        "overhead_ratio": round(t_blocks / t_plain, 4),
+        "note": "same causal FLOPs: plain = one fused kernel; block visit = "
+                "3 chunk kernels (1 full + 2 causal) + (out,lse) merge — "
+                "the ring's per-visit decomposition cost on one chip",
+    }
+
+
 def bench_control_plane():
     from odh_kubeflow_tpu.api.core import Container
     from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
@@ -650,6 +752,12 @@ def main() -> None:
     except Exception as e:
         detail["control_plane"] = {"error": repr(e)[:300]}
 
+    # static (hardware-free) ring balance tables — always recorded
+    try:
+        detail["ring_balance"] = bench_ring_balance()
+    except Exception as e:
+        detail["ring_balance"] = {"error": repr(e)[:300]}
+
     # watchdog: the dispatch tunnel occasionally wedges with the main thread
     # blocked inside a C extension call (observed in round 3: trivial ops
     # hang indefinitely). Signals can't preempt a thread stuck in C, so a
@@ -706,6 +814,8 @@ def main() -> None:
         run_section("moe_train_step", bench_moe_train_step, optional=True)
         run_section("decode_long_cache", bench_decode_long_cache, optional=True)
         run_section("attention_memory", bench_attention_memory, optional=True)
+        run_section("flash_block_overhead", bench_flash_block_overhead,
+                    optional=True)
         watchdog_fired.set()  # disarm
 
     if on_tpu and kernels and train and "error" not in detail.get("train_step", {}):

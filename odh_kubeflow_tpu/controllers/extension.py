@@ -797,8 +797,22 @@ def sync_runtime_images(client, config, namespace: str, fresh=None) -> bool:
             return True
     except NotFoundError:
         pass
-    retry_on_conflict(lambda: _apply_runtime_images(fresh, namespace, data))
-    return True
+
+    # write decision: REBUILD the content from fresh sources too — writing
+    # the cached-derived `data` could roll a newer catalog back to the memo
+    # window's stale source list
+    def write_attempt() -> bool:
+        fresh_data = _build_runtime_images(fresh, config)
+        if not fresh_data:
+            try:
+                fresh.delete(ConfigMap, namespace, RUNTIME_IMAGES_CONFIGMAP)
+            except NotFoundError:
+                pass
+            return False
+        _apply_runtime_images(fresh, namespace, fresh_data)
+        return True
+
+    return retry_on_conflict(write_attempt)
 
 
 def _apply_runtime_images(fresh, namespace: str, data: dict) -> None:
@@ -845,8 +859,66 @@ def sync_elyra_secret(client, config, namespace: str, fresh=None) -> bool:
     fields. Returns True when the Secret exists after the sync.
 
     Same read/write split as sync_runtime_images: possibly-stale `client`
-    reads drive derivation and no-op detection only; the write runs against
-    `fresh` under conflict retry."""
+    reads drive the no-op pre-check only; the write path RE-DERIVES the
+    desired content from `fresh` inside the conflict retry (writing
+    cached-derived content could roll a newer render back)."""
+    fresh = fresh or getattr(client, "fresh", client)
+    derived = _derive_elyra_config(client, config, namespace)
+    if derived is None:
+        return False
+    owner, desired = derived
+
+    # no-op pre-check on the (possibly stale) cached view
+    try:
+        cached = client.get(Secret, namespace, ELYRA_SECRET_NAME)
+        if cached.string_data == desired and (
+            owner is None or cached.owned_by(owner)
+        ):
+            return True
+    except NotFoundError:
+        pass
+
+    def attempt() -> bool:
+        fresh_derived = _derive_elyra_config(fresh, config, namespace)
+        if fresh_derived is None:
+            return False  # sources vanished since the cached read: no write
+        f_owner, f_desired = fresh_derived
+        try:
+            cur = fresh.get(Secret, namespace, ELYRA_SECRET_NAME)
+        except NotFoundError:
+            secret = Secret()
+            secret.metadata.name = ELYRA_SECRET_NAME
+            secret.metadata.namespace = namespace
+            secret.string_data = f_desired
+            secret.type = "Opaque"
+            if f_owner is not None:
+                # owned by the DSPA, as the reference's secret is (:280-371)
+                secret.set_owner(f_owner, controller=False)
+            try:
+                fresh.create(secret)
+            except AlreadyExistsError:
+                pass
+            return True
+        changed = False
+        if cur.string_data != f_desired:
+            cur.string_data = f_desired
+            changed = True
+        if f_owner is not None and not cur.owned_by(f_owner):
+            # a DSPA that appeared after the secret was first rendered must
+            # still own it (GC on DSPA deletion — reference :280-371)
+            cur.set_owner(f_owner, controller=False)
+            changed = True
+        if changed:
+            fresh.update(cur)
+        return True
+
+    return retry_on_conflict(attempt)
+
+
+def _derive_elyra_config(client, config, namespace: str):
+    """The Elyra render half of sync_elyra_secret: (owner, desired data) or
+    None when no pipeline config source exists. Pure reads — callable
+    against either the cached or the fresh client."""
     from ..api.dspa import DSPA_NAME, DataSciencePipelinesApplication
 
     owner = None
@@ -905,7 +977,7 @@ def sync_elyra_secret(client, config, namespace: str, fresh=None) -> bool:
                 Secret, config.controller_namespace, PIPELINE_SERVER_SECRET
             )
         except NotFoundError:
-            return False
+            return None
         meta = {
             "api_endpoint": src.string_data.get("api_endpoint", ""),
             "public_api_endpoint": src.string_data.get("public_api_endpoint", ""),
@@ -930,45 +1002,4 @@ def sync_elyra_secret(client, config, namespace: str, fresh=None) -> bool:
         },
     }
     desired = {"odh_dsp.json": json.dumps(cfg, sort_keys=True)}
-    fresh = fresh or getattr(client, "fresh", client)
-    # no-op pre-check on the (possibly stale) cached view
-    try:
-        cached = client.get(Secret, namespace, ELYRA_SECRET_NAME)
-        if cached.string_data == desired and (
-            owner is None or cached.owned_by(owner)
-        ):
-            return True
-    except NotFoundError:
-        pass
-
-    def attempt():
-        try:
-            cur = fresh.get(Secret, namespace, ELYRA_SECRET_NAME)
-        except NotFoundError:
-            secret = Secret()
-            secret.metadata.name = ELYRA_SECRET_NAME
-            secret.metadata.namespace = namespace
-            secret.string_data = desired
-            secret.type = "Opaque"
-            if owner is not None:
-                # owned by the DSPA, as the reference's secret is (:280-371)
-                secret.set_owner(owner, controller=False)
-            try:
-                fresh.create(secret)
-            except AlreadyExistsError:
-                pass
-            return
-        changed = False
-        if cur.string_data != desired:
-            cur.string_data = desired
-            changed = True
-        if owner is not None and not cur.owned_by(owner):
-            # a DSPA that appeared after the secret was first rendered must
-            # still own it (GC on DSPA deletion — reference :280-371)
-            cur.set_owner(owner, controller=False)
-            changed = True
-        if changed:
-            fresh.update(cur)
-
-    retry_on_conflict(attempt)
-    return True
+    return owner, desired

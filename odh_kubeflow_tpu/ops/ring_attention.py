@@ -322,6 +322,56 @@ def ring_attention(
 # ---------------------------------------------------------------------------
 
 
+def ring_balance_report(sp: int, layout: str = "contiguous") -> dict:
+    """Static per-rank block-unit accounting for the causal ring schedule —
+    the load-balance claim above as NUMBERS (no hardware needed; the
+    classification below is the same chunk-id rule the kernels switch on).
+
+    Unit = one (chunk x chunk) full flash block at chunk = seq/(2*sp);
+    a diagonal (causal) pair counts 0.5 (the balanced causal grid skips the
+    upper triangle). The contiguous layout's shard-pair blocks are 2x2
+    chunks (full = 4 units, shard-diagonal = 2). Lockstep SPMD makes each
+    ring step cost the busiest rank's units (the collective synchronizes
+    every rank), so wall = sum over steps of max-units; `balance_ratio` =
+    wall / ideal (total units / sp) — ~2 for contiguous, ~1 for zigzag."""
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    per_rank = [[0.0] * sp for _ in range(sp)]  # [rank][step]
+    for step in range(sp):
+        for my in range(sp):
+            src = (my - step) % sp  # the K/V shard visiting rank `my`
+            if layout == "contiguous":
+                # one shard-pair: full if src < my, diagonal if src == my
+                if src < my:
+                    per_rank[my][step] = 4.0
+                elif src == my:
+                    per_rank[my][step] = 2.0
+            else:
+                # local q = [chunk my | chunk 2sp-1-my]; visiting
+                # K/V = [chunk src | chunk 2sp-1-src] — the 4-pair rule
+                # (see the comment block above / visit_bwd)
+                units = 1.0  # qb vs ka: always full
+                if src == my:
+                    units += 0.5 + 0.5  # qa-ka diag + qb-kb diag
+                elif src < my:
+                    units += 1.0  # qa vs ka full
+                else:
+                    units += 1.0  # qb vs kb full
+                per_rank[my][step] = units
+    totals = [sum(row) for row in per_rank]
+    wall = sum(max(per_rank[r][t] for r in range(sp)) for t in range(sp))
+    ideal = sum(totals) / sp
+    return {
+        "layout": layout,
+        "sp": sp,
+        "per_rank_units_per_step": per_rank,
+        "per_rank_total_units": totals,
+        "lockstep_wall_units": wall,
+        "ideal_wall_units": ideal,
+        "balance_ratio": wall / ideal,
+    }
+
+
 def zigzag_permutation(seq_len: int, sp: int):
     """Natural-order positions in zigzag storage order: the concatenation,
     over ranks r, of chunk r then chunk 2*sp-1-r (chunk = seq_len/(2*sp)).

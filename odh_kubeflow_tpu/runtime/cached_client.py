@@ -110,9 +110,16 @@ class TTLReadClient(Client):
 
     @property
     def fresh(self) -> Client:
-        """The unmemoized inner client — the read side every write decision
-        must use (see sync_runtime_images' read/write split)."""
-        return self._inner
+        """Unmemoized view — the side every write decision must use (see
+        sync_runtime_images' read/write split). Its WRITES invalidate this
+        memo, so a helper that creates through `fresh` never has its own
+        object served stale by the memoized 404 it read moments before."""
+        return _FreshView(self)
+
+    def _invalidate_key(self, cls, namespace: str, name: str) -> None:
+        with self._lock:
+            self._get_memo.pop(self._key(cls, namespace, name), None)
+            self._list_memo.clear()  # lists are cheap to refill; stay correct
 
     def _key(self, cls, namespace, name):
         av, kind = self._av_kind(cls)
@@ -167,44 +174,69 @@ class TTLReadClient(Client):
             self._list_memo[key] = (now, [o.to_dict() for o in out])
         return out
 
-    def _invalidate(self, obj) -> None:
-        meta = obj.metadata
-        key = self._key(type(obj), meta.namespace, meta.name)
-        with self._lock:
-            self._get_memo.pop(key, None)
-            self._list_memo.clear()  # lists are cheap to refill; stay correct
+    # writes delegate to the fresh view: inner write + memo invalidation
+    def create(self, obj):
+        return self.fresh.create(obj)
+
+    def update(self, obj):
+        return self.fresh.update(obj)
+
+    def delete(self, cls: Type[T], namespace: str, name: str) -> None:
+        self.fresh.delete(cls, namespace, name)
+
+    def patch(self, cls: Type[T], namespace: str, name: str, patch: dict) -> T:
+        return self.fresh.patch(cls, namespace, name, patch)
+
+    def update_status(self, obj):
+        return self.fresh.update_status(obj)
+
+    def patch_status(self, cls: Type[T], namespace: str, name: str, patch: dict) -> T:
+        return self.fresh.patch_status(cls, namespace, name, patch)
+
+
+class _FreshView(Client):
+    """TTLReadClient.fresh: unmemoized reads straight off the inner client,
+    writes that clear the owner's memo for the touched key."""
+
+    def __init__(self, owner: TTLReadClient):
+        super().__init__(owner._inner.store, owner._inner.scheme)
+        self._owner = owner
+        self._inner = owner._inner
+
+    def get(self, cls: Type[T], namespace: str, name: str) -> T:
+        return self._inner.get(cls, namespace, name)
+
+    def list(self, cls, namespace=None, labels=None):
+        return self._inner.list(cls, namespace=namespace, labels=labels)
 
     def create(self, obj):
         out = self._inner.create(obj)
-        self._invalidate(obj)
+        self._owner._invalidate_key(type(obj), obj.metadata.namespace,
+                                    obj.metadata.name)
         return out
 
     def update(self, obj):
         out = self._inner.update(obj)
-        self._invalidate(obj)
+        self._owner._invalidate_key(type(obj), obj.metadata.namespace,
+                                    obj.metadata.name)
         return out
 
     def delete(self, cls: Type[T], namespace: str, name: str) -> None:
         self._inner.delete(cls, namespace, name)
-        with self._lock:
-            self._get_memo.pop(self._key(cls, namespace, name), None)
-            self._list_memo.clear()
+        self._owner._invalidate_key(cls, namespace, name)
 
     def patch(self, cls: Type[T], namespace: str, name: str, patch: dict) -> T:
         out = self._inner.patch(cls, namespace, name, patch)
-        with self._lock:
-            self._get_memo.pop(self._key(cls, namespace, name), None)
-            self._list_memo.clear()
+        self._owner._invalidate_key(cls, namespace, name)
         return out
 
     def update_status(self, obj):
         out = self._inner.update_status(obj)
-        self._invalidate(obj)
+        self._owner._invalidate_key(type(obj), obj.metadata.namespace,
+                                    obj.metadata.name)
         return out
 
     def patch_status(self, cls: Type[T], namespace: str, name: str, patch: dict) -> T:
         out = self._inner.patch_status(cls, namespace, name, patch)
-        with self._lock:
-            self._get_memo.pop(self._key(cls, namespace, name), None)
-            self._list_memo.clear()
+        self._owner._invalidate_key(cls, namespace, name)
         return out

@@ -412,3 +412,50 @@ def test_pp_moe_interleaved_1f1b_parity():
             err_msg=jax.tree_util.keystr(path_g),
         )
     assert float(jnp.sum(jnp.abs(f_grads["layers"]["router"]))) > 0
+
+
+def test_ep_indexed_matches_dense_on_mesh():
+    """VERDICT r4 #7: the indexed dispatch is the live-ep GSPMD path. At
+    ample capacity the shard_map'd indexed path (_moe_ffn_ep_indexed)
+    produces the same outputs and expert/router gradients as the dense
+    one-hot einsum path on the same ep mesh; only the aux statistics window
+    differs (per-data-shard vs global batch)."""
+    from dataclasses import replace
+    from jax.sharding import NamedSharding
+
+    from odh_kubeflow_tpu.models.moe import moe_ffn, init_moe_params
+
+    plan = MeshPlan.auto(8, want_ep=2, want_tp=2)
+    mesh = plan.build(jax.devices()[:8])
+    d = 32
+    cfg_idx = MoEConfig(
+        n_experts=4, experts_per_token=2, capacity_factor=8.0, d_ff=64,
+        dispatch="auto",
+    )
+    cfg_dense = replace(cfg_idx, dispatch="dense")
+    params = init_moe_params(jax.random.PRNGKey(0), d, cfg_idx, jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16, d), jnp.float32)
+
+    def run(cfg):
+        def f(p, x):
+            out, aux = moe_ffn(x, p, cfg, mesh=mesh)
+            return jnp.sum(out**2), (out, aux)
+
+        (loss, (out, aux)), grads = jax.jit(
+            jax.value_and_grad(f, has_aux=True)
+        )(params, x)
+        jax.block_until_ready(loss)
+        return out, aux, grads
+
+    out_i, aux_i, g_i = run(cfg_idx)
+    out_d, aux_d, g_d = run(cfg_dense)
+    np.testing.assert_allclose(
+        np.asarray(out_i), np.asarray(out_d), atol=1e-5, rtol=1e-5
+    )
+    for name in ("we_gate", "we_up", "we_out", "router"):
+        np.testing.assert_allclose(
+            np.asarray(g_i[name]), np.asarray(g_d[name]),
+            atol=1e-5, rtol=1e-4, err_msg=name,
+        )
+    # aux windows differ (per-shard vs global) but both are O(1) balanced
+    assert 0.5 < float(aux_i) / float(aux_d) < 2.0

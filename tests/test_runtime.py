@@ -298,3 +298,70 @@ def test_cached_client_split_semantics():
         assert mgr.api_reader.get(ConfigMap, "ns", "a").metadata.name == "a"
     finally:
         mgr.informers.stop_all()
+
+
+def test_ttl_read_client_memo_and_invalidation():
+    """TTLReadClient (the webhook's read memo): 404s memoize within the TTL;
+    writes — through the TTL client OR its fresh view — invalidate, so a
+    helper that creates through `fresh` is never served its own stale 404."""
+    import time as _time
+
+    from odh_kubeflow_tpu.api.core import ConfigMap
+    from odh_kubeflow_tpu.apimachinery import NotFoundError
+    from odh_kubeflow_tpu.cluster import Store
+    from odh_kubeflow_tpu.cluster.client import Client
+    from odh_kubeflow_tpu.runtime.cached_client import TTLReadClient
+
+    store = Store()
+    inner = Client(store)
+    calls = {"get": 0}
+    real_get = inner.get
+
+    def counting_get(cls, ns, name):
+        calls["get"] += 1
+        return real_get(cls, ns, name)
+
+    inner.get = counting_get
+    ttl = TTLReadClient(inner, ttl_s=30.0)
+
+    import pytest
+
+    with pytest.raises(NotFoundError):
+        ttl.get(ConfigMap, "ns", "cm")
+    with pytest.raises(NotFoundError):
+        ttl.get(ConfigMap, "ns", "cm")  # memoized negative
+    assert calls["get"] == 1
+
+    # create through the FRESH view invalidates the negative entry
+    cm = ConfigMap()
+    cm.metadata.name = "cm"
+    cm.metadata.namespace = "ns"
+    cm.data = {"k": "1"}
+    ttl.fresh.create(cm)
+    assert ttl.get(ConfigMap, "ns", "cm").data == {"k": "1"}
+    assert calls["get"] == 2
+
+    # positive entries memoize; update through the TTL client invalidates
+    ttl.get(ConfigMap, "ns", "cm")
+    assert calls["get"] == 2
+    cur = ttl.fresh.get(ConfigMap, "ns", "cm")
+    cur.data = {"k": "2"}
+    ttl.update(cur)
+    assert ttl.get(ConfigMap, "ns", "cm").data == {"k": "2"}
+
+    # list memo: second identical list is served without an inner call
+    lcalls = {"n": 0}
+    real_list = inner.list
+
+    def counting_list(cls, namespace=None, labels=None):
+        lcalls["n"] += 1
+        return real_list(cls, namespace=namespace, labels=labels)
+
+    inner.list = counting_list
+    assert len(ttl.list(ConfigMap, namespace="ns")) == 1
+    assert len(ttl.list(ConfigMap, namespace="ns")) == 1
+    assert lcalls["n"] == 1
+    # any write clears list memos
+    ttl.delete(ConfigMap, "ns", "cm")
+    assert ttl.list(ConfigMap, namespace="ns") == []
+    assert lcalls["n"] == 2
