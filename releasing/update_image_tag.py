@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Pin a released controller image tag into the deploy tree.
+
+The reference ships releasing/update-manifests-images, a ruamel-yaml patcher
+that rewrites image tags inside kustomize manifests in place (reference
+releasing/update-manifests-images:50-120). This build's manifests are
+GENERATED from deploy/params.env by odh_kubeflow_tpu.deploy (the drift gate
+ci/generate_manifests.sh keeps the tree honest), so the release updater has
+one job: rewrite the params.env pin and regenerate — the generator, not a
+YAML patcher, is the single source of truth.
+
+Usage:
+    releasing/update_image_tag.py v1.2.0
+    releasing/update_image_tag.py --image ghcr.io/me/controller v1.2.0
+    releasing/update_image_tag.py --check v1.2.0   # verify-only (CI)
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PARAMS = REPO / "deploy" / "params.env"
+IMAGE_KEY = "odh-notebook-controller-image"
+VERSION_FILE = pathlib.Path(__file__).resolve().parent / "version"
+
+
+def current_pin() -> str:
+    for line in PARAMS.read_text().splitlines():
+        if line.startswith(f"{IMAGE_KEY}="):
+            return line.split("=", 1)[1]
+    raise SystemExit(f"{IMAGE_KEY} not found in {PARAMS}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("tag", help="release tag, e.g. v1.2.0")
+    ap.add_argument(
+        "--image", default=None,
+        help="image repository (default: keep the repository from params.env)",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="verify params.env + the generated tree already carry the tag",
+    )
+    args = ap.parse_args()
+    if not re.fullmatch(r"v\d+\.\d+\.\d+(-[A-Za-z0-9.]+)?", args.tag):
+        raise SystemExit(f"tag {args.tag!r} is not vMAJOR.MINOR.PATCH[-suffix]")
+
+    repo_part = args.image or current_pin().rsplit(":", 1)[0]
+    pinned = f"{repo_part}:{args.tag}"
+
+    if args.check:
+        if current_pin() != pinned:
+            print(f"params.env pins {current_pin()}, expected {pinned}")
+            return 1
+        print(f"image pin ok: {pinned}")
+        return 0
+
+    lines = PARAMS.read_text().splitlines()
+    out = [
+        f"{IMAGE_KEY}={pinned}" if line.startswith(f"{IMAGE_KEY}=") else line
+        for line in lines
+    ]
+    PARAMS.write_text("\n".join(out) + "\n")
+    VERSION_FILE.write_text(args.tag + "\n")
+    # regenerate the committed manifest trees from the new pin (the same
+    # command the drift gate runs)
+    subprocess.run(
+        [sys.executable, "-m", "odh_kubeflow_tpu.deploy", "generate",
+         "--root", "deploy"],
+        cwd=REPO, check=True,
+    )
+    print(f"pinned {pinned}; deploy/ regenerated (commit both)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
