@@ -216,8 +216,12 @@ def bench_train_step():
     import os
 
     # A/B knob for the remat policy without code edits (VERDICT r4 #9):
-    # "" = save nothing, "dots" = matmul outputs, "attn" = attention outputs
-    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "")
+    # "" = save nothing, "dots" = matmul outputs (measured no-op: every dot
+    # here carries a batch dim), "flash" = the flash kernel's (out, lse)
+    # residuals so the backward skips the forward-kernel recompute, "attn" =
+    # flash + post-projection output. Default is the measured winner on
+    # v5e-1 (r5 A/B: "" 193.5 ms, dots 197.2, attn-old 197.2, flash ~179).
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY", "flash")
     cfg = TransformerConfig(
         vocab=32768,
         d_model=1024,

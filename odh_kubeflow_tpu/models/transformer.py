@@ -44,13 +44,23 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     remat: bool = True
     # What the layer checkpoint SAVES (only meaningful with remat=True):
-    #   ""     — save nothing: minimum memory, recompute everything (incl.
-    #            the flash forward kernel) during backward,
-    #   "dots" — save matmul outputs without batch dims (XLA's standard
-    #            selective-remat sweet spot: HBM for avoided FLOPs),
-    #   "attn" — save ONLY the attention outputs (checkpoint_name'd): the
-    #            single most expensive recompute in the layer, at a fraction
-    #            of "dots"'s memory.
+    #   ""      — save nothing: minimum memory, recompute everything (incl.
+    #             the flash forward kernel) during backward,
+    #   "dots"  — save matmul outputs without batch dims (XLA's standard
+    #             selective-remat sweet spot — a no-op here: every matmul in
+    #             this model carries the batch dim, kept for the A/B record),
+    #   "flash" — save ONLY the flash kernel's (out, lse) residuals
+    #             (checkpoint_name'd in ops/attention._flash_diff_fwd): the
+    #             kernel backward consumes exactly these, so the forward
+    #             kernel's recompute is DCE'd from the backward at ~33 MB
+    #             per layer (b8 s2048 d1024). Measured best on v5e-1:
+    #             193.5 -> ~179 ms/step on the bench config.
+    #   "attn"  — "flash" plus the post-projection attention output
+    #             ("attn_out"): additionally skips the wo-projection
+    #             recompute for one more bf16 activation of memory.
+    # "flash"/"attn" names only exist when the pallas kernel path is live
+    # (use_flash=True on TPU/interpret); on the mha_reference fallback the
+    # name set matches nothing and the policy degrades to save-nothing.
     remat_policy: str = ""
     use_flash: bool = True
     seq_axis: str = ""  # set to "sp" to run ring attention over that mesh axis
@@ -233,8 +243,18 @@ def _remat_policy(cfg: TransformerConfig):
     nothing)."""
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "flash":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        )
     if cfg.remat_policy == "attn":
-        return jax.checkpoint_policies.save_only_these_names("attn_out")
+        # Without the kernel residuals this name set was a measured no-op:
+        # the flash backward needs lse (and out), so saving just the
+        # post-projection output left the whole forward kernel in the
+        # backward anyway.
+        return jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "flash_out", "flash_lse"
+        )
     if cfg.remat_policy:
         raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
     return None
@@ -407,6 +427,33 @@ def make_zigzag_batch(tokens, sp: int):
     }
 
 
+def causal_ce(logits, targets, mask=None):
+    """Cross-entropy -E[log p(target)] in lse form: log_softmax
+    materializes a full f32 (b, s, V) logp tensor and its vjp makes several
+    more passes; lse + target-logit gather keeps one fused reduction pass
+    and a one-pass backward (exp(logits-lse) - onehot). Numerically
+    identical (same f32 logits, same max-shifted sums). mask=None means
+    every position counts."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tl = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return -jnp.mean(tl - lse)
+    return -jnp.sum((tl - lse) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def next_token_ce(logits, tokens):
+    """Next-token CE over full-shape logits via roll+mask instead of
+    logits[:, :-1]: the slice to seq-1 forces a copy/unaligned ops over the
+    (b, s, V) f32 logits (~2 GB at the bench config). Rolled targets +
+    masking the last position computes the SAME mean over the same b*(s-1)
+    terms (position s-1's rolled target is token 0 — fabricated, masked)."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = (
+        jnp.arange(tokens.shape[1]) < tokens.shape[1] - 1
+    ).astype(jnp.float32)[None, :]
+    return causal_ce(logits, targets, jnp.broadcast_to(mask, tokens.shape))
+
+
 def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
     """Causal LM cross-entropy (+ router load-balance aux for MoE configs).
     batch: {"tokens": (b, s), "positions"?}."""
@@ -415,16 +462,11 @@ def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
         params, tokens, cfg, mesh=mesh, positions=batch.get("positions"), with_aux=True
     )
     targets = batch.get("targets")
-    mask = batch.get("loss_mask")  # only meaningful with explicit targets
+    mask = batch.get("loss_mask")  # optional with explicit targets
     if targets is None:
-        logits, targets = logits[:, :-1], tokens[:, 1:]
-        mask = None
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        loss = next_token_ce(logits, tokens)
     else:
-        loss = -jnp.mean(ll)
+        loss = causal_ce(logits, targets, mask)
     if cfg.moe is not None:
         loss = loss + cfg.moe.router_aux_weight * aux / cfg.n_layers
     return loss
@@ -574,10 +616,7 @@ def pp_loss_fn(params, batch, cfg: TransformerConfig, mesh, n_micro: int = 4,
         params, tokens, cfg, mesh, n_micro=n_micro, with_aux=True,
         n_chunks=n_chunks,
     )
-    logits, targets = logits[:, :-1], tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    loss = -jnp.mean(ll)
+    loss = next_token_ce(logits, tokens)
     if cfg.moe is not None:
         loss = loss + cfg.moe.router_aux_weight * aux / cfg.n_layers
     return loss
@@ -637,10 +676,7 @@ def pp_1f1b_value_and_grad(params, batch, cfg: TransformerConfig, mesh,
         logits = jnp.einsum(
             "bsd,dv->bsv", z, hp["unembed"], preferred_element_type=jnp.float32
         )
-        logits, tg = logits[:, :-1], tgt_mb[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, tg[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        return next_token_ce(logits, tgt_mb)
 
     head_params = {
         "final_norm": params["final_norm"], "unembed": params["unembed"]
