@@ -245,7 +245,7 @@ def test_remat_policies_preserve_loss_and_grads():
         return jax.value_and_grad(loss_fn)(params, {"tokens": tokens}, cfg)
 
     ref_loss, ref_g = lg(replace(base, remat=False))
-    for policy in ("", "dots", "attn"):
+    for policy in ("", "dots", "attn", "flash"):
         loss, g = lg(replace(base, remat_policy=policy))
         assert np.allclose(float(loss), float(ref_loss), atol=1e-6), policy
         for (pa, a), (pb, b) in zip(
@@ -260,6 +260,93 @@ def test_remat_policies_preserve_loss_and_grads():
 
     with pytest.raises(ValueError):
         loss_fn(params, {"tokens": tokens}, replace(base, remat_policy="bogus"))
+
+
+def test_flash_remat_policy_with_live_kernel_residuals():
+    """remat_policy="flash"/"attn" with the pallas kernel actually running
+    (interpret mode): the checkpoint_name'd (out, lse) residuals exist in
+    the traced region, the policy pins them, and loss/gradients stay
+    identical to remat=False. This is the path the no-op "attn" bug hid in
+    (the policy saved the post-projection output but the kernel vjp still
+    reran the forward for lse); the fix is only exercised when the kernel
+    path is live — the use_flash=False test above degrades to
+    save-nothing by design."""
+    from dataclasses import replace
+    from functools import partial
+
+    import numpy as np
+
+    import odh_kubeflow_tpu.models.transformer as T
+
+    # block_k >= 128 is the kernel's floor, so seq must be >= 128
+    base = TransformerConfig(
+        vocab=64, d_model=128, n_layers=2, n_heads=2, d_ff=128, max_seq=128,
+        dtype=jnp.float32, use_flash=True, remat=True,
+    )
+    params = init_params(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 128), 0, base.vocab)
+
+    orig = T.flash_attention
+    T.flash_attention = partial(orig, interpret=True)
+    try:
+        def lg(cfg):
+            return jax.value_and_grad(loss_fn)(params, {"tokens": tokens}, cfg)
+
+        ref_loss, ref_g = lg(replace(base, remat=False))
+        for policy in ("", "flash", "attn"):
+            loss, g = lg(replace(base, remat_policy=policy))
+            assert np.allclose(float(loss), float(ref_loss), atol=1e-6), policy
+            for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_flatten_with_path(g)[0],
+                jax.tree_util.tree_flatten_with_path(ref_g)[0],
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+                    err_msg=f"{policy} {jax.tree_util.keystr(pa)}",
+                )
+    finally:
+        T.flash_attention = orig
+
+
+def test_causal_ce_matches_log_softmax_reference():
+    """causal_ce/next_token_ce (lse-form over full-shape logits, roll+mask)
+    equal the textbook sliced log_softmax formulation exactly — the CE
+    rewrite is a memory-traffic optimization, never a math change. Also
+    pins the explicit-targets-without-mask path (a latent TypeError before
+    round 5's causal_ce: mask=None fell through to `ll * None`)."""
+    import numpy as np
+
+    from odh_kubeflow_tpu.models.transformer import causal_ce, next_token_ce
+
+    b, s, V = 2, 16, 32
+    logits = jax.random.normal(jax.random.PRNGKey(0), (b, s, V), jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, V)
+
+    # textbook reference: slice, log_softmax, gather, mean
+    sliced, targets = logits[:, :-1], tokens[:, 1:]
+    logp = jax.nn.log_softmax(sliced, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    ref = -jnp.mean(ll)
+
+    got = next_token_ce(logits, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+
+    # explicit targets WITHOUT a mask: every position counts, plain mean
+    tg = jax.random.randint(jax.random.PRNGKey(2), (b, s), 0, V)
+    logp_all = jax.nn.log_softmax(logits, axis=-1)
+    ll_all = jnp.take_along_axis(logp_all, tg[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(
+        np.asarray(causal_ce(logits, tg)), np.asarray(-jnp.mean(ll_all)),
+        rtol=1e-6,
+    )
+
+    # explicit targets WITH a mask: masked-mean semantics
+    mask = (jnp.arange(s)[None, :] % 2 == 0).astype(jnp.float32)
+    mask = jnp.broadcast_to(mask, (b, s))
+    want = -jnp.sum(ll_all * mask) / jnp.sum(mask)
+    np.testing.assert_allclose(
+        np.asarray(causal_ce(logits, tg, mask)), np.asarray(want), rtol=1e-6
+    )
 
 
 def test_zigzag_seq_layout_loss_matches_natural():
