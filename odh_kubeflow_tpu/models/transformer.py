@@ -64,6 +64,10 @@ class TransformerConfig:
     remat_policy: str = ""
     use_flash: bool = True
     seq_axis: str = ""  # set to "sp" to run ring attention over that mesh axis
+    # INTERNAL (set by _pp_manual_layout on stage configs, never by users):
+    # the sp axis is already bound by an enclosing shard_map, so _attention
+    # calls the ring directly instead of wrapping its own shard_map.
+    seq_axis_bound: bool = False
     # Sequence-shard layout for the ring ("contiguous" | "zigzag"). Zigzag
     # (shard r holds chunks r and 2S-1-r of the sequence) load-balances the
     # causal ring: every rank computes ~2 block-units per visit instead of
@@ -206,6 +210,18 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
     mha_reference, AND the ring — consumes GQA natively; K/V are never
     expanded, so the HBM win applies on the training path too (ring K/V
     rotate the ICI at kv_heads width)."""
+    if cfg.seq_axis and cfg.seq_axis_bound:
+        # inside an enclosing shard_map (pipeline stages): the sp axis name
+        # is already bound, activations arrive seq-sharded — run the ring
+        # directly. Contiguous layout only: zigzag needs permuted batches,
+        # which the pipeline engines do not thread (parallel/pipeline.py
+        # module docstring records the boundary).
+        if cfg.seq_layout == "zigzag":
+            raise ValueError(
+                'seq_layout="zigzag" is not composed with pipeline stages; '
+                'use the contiguous ring (seq_layout="contiguous") under pp'
+            )
+        return ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
     if cfg.seq_axis and mesh is not None:
         # ppermute needs bound axis names: run the ring under shard_map over
         # the FULL mesh; only `sp` collectives occur, other axes stay local.
@@ -512,6 +528,12 @@ def _pp_manual_layout(cfg: TransformerConfig, mesh):
         gather_axes = {"wqkv": 1, "wo": 3}
         if cfg.moe is None:
             gather_axes.update({"wi_gate": 1, "wi_up": 1, "wo_mlp": 2})
+    if pp > 1 and cfg.seq_axis and sizes.get(cfg.seq_axis, 1) > 1:
+        # sp INSIDE stages: activations arrive seq-sharded (pipeline_apply
+        # seq_axis), the ring runs on the already-bound axis
+        from dataclasses import replace
+
+        cfg_stage = replace(cfg_stage, seq_axis_bound=True)
     return tp_axis, gather_axes, cfg_stage
 
 
@@ -576,13 +598,23 @@ def pp_forward(
 
     # (1, seq): broadcasts against any microbatch size inside the stages
     positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+    sp_live = cfg_stage.seq_axis_bound  # set by _pp_manual_layout: sp > 1
 
     table = params["embed"].astype(cfg.dtype)
     x = table[tokens]
 
     def stage_fn(stage_layers, h):
+        if sp_live:
+            # h is a sequence SHARD: rope/causal positions are the shard's
+            # global offsets, derived from the bound sp coordinate
+            local_s = h.shape[1]
+            start = lax.axis_index(cfg.seq_axis) * local_s
+            pos = (start + jnp.arange(local_s, dtype=jnp.int32))[None, :]
+        else:
+            pos = positions
+
         def scan_fn(carry, layer_params):
-            return _layer(carry, layer_params, positions, cfg_stage, mesh=None,
+            return _layer(carry, layer_params, pos, cfg_stage, mesh=None,
                           ep_axis=ep_axis, tp_axis=tp_axis)
 
         h, auxes = lax.scan(scan_fn, h, stage_layers)
@@ -599,6 +631,7 @@ def pp_forward(
         with_aux=True, param_specs=param_specs_,
         param_prepare=param_prepare if gather_axes else None,
         n_chunks=n_chunks,
+        seq_axis=cfg.seq_axis if sp_live else "",
     )
     x = rms_norm(x, params["final_norm"])
     logits = jnp.einsum(
@@ -651,6 +684,12 @@ def pp_1f1b_value_and_grad(params, batch, cfg: TransformerConfig, mesh,
     from ..parallel.pipeline import pipeline_value_and_grad_1f1b
 
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if cfg.seq_axis and sizes.get(cfg.seq_axis, 1) > 1:
+        raise NotImplementedError(
+            "sp inside pipeline stages is composed with the GPipe schedule "
+            "only (pp_loss_fn); the 1F1B engines do not thread sequence "
+            "shards through their backward buffers"
+        )
     tp_axis, gather_axes, cfg_stage = _pp_manual_layout(cfg, mesh)
     ep_axis = "ep" if cfg.moe is not None else ""
     aux_weight = (

@@ -22,6 +22,15 @@ elsewhere. Pipeline composes with the other axes:
 - **ep inside stages**: MoE expert weights keep their ep shard
   (manual-collective MoE, models/moe._moe_ffn_manual).
 
+- **sp (ring attention) inside stages — GPipe schedule only**: pass
+  `seq_axis="sp"` so activations shard (batch, seq/sp, d); the stage then
+  runs the contiguous ring on the already-bound axis
+  (models/transformer._attention's seq_axis_bound path) with per-shard
+  rope positions derived from `lax.axis_index`. The 1F1B/interleaved
+  engines do not thread sequence shards through their backward buffers and
+  raise NotImplementedError; zigzag layout needs permuted batches the
+  engines don't thread — contiguous only.
+
 Everything (ppermute, masked scatter, psum broadcast) is differentiable, so
 the same function trains.
 """
@@ -47,6 +56,7 @@ def pipeline_apply(
     param_specs: Any = None,
     param_prepare: Optional[Callable[[Any], Any]] = None,
     n_chunks: int = 1,
+    seq_axis: str = "",
 ):
     """Run stage-stacked parameters as a microbatched pipeline.
 
@@ -69,7 +79,11 @@ def pipeline_apply(
     shard, or dense weights stored tp/fsdp-sharded;
     param_prepare: optional transform applied ONCE to the local stage params
     inside the shard_map, before the microbatch loop — the ZeRO all-gather
-    hook (its AD transpose reduce-scatters the gradients).
+    hook (its AD transpose reduce-scatters the gradients);
+    seq_axis: shard x's dim 1 (sequence) over this mesh axis so stage_fn
+    runs on sequence shards — the stage then does ring attention on the
+    bound axis (models/transformer._attention seq_axis_bound path). GPipe
+    schedule only.
 
     Returns the last stage's outputs, replicated over `axis` (plus, with
     with_aux, the aux scalars summed over stages and real microbatches —
@@ -89,6 +103,11 @@ def pipeline_apply(
     if local_batch % n_micro:
         raise ValueError(
             f"per-data-shard batch {local_batch} not divisible by n_micro {n_micro}"
+        )
+    if seq_axis and sizes.get(seq_axis, 1) > 1 and n_chunks > 1:
+        raise NotImplementedError(
+            "sp inside pipeline stages is composed with the GPipe schedule "
+            "only; the interleaved engine does not thread sequence shards"
         )
     if n_chunks > 1:
         if n_micro % n_stages:
@@ -139,9 +158,23 @@ def pipeline_apply(
         aux_total = lax.psum(aux_total, axis)  # sum stage contributions
         for a in data_axes:  # identical scalar on every rank (out_spec P())
             aux_total = lax.pmean(aux_total, a)
+        if seq_axis and sizes.get(seq_axis, 1) > 1:
+            # Replicate the scalar for out_spec P(). NOTE: with MoE this is
+            # the mean of PER-SHARD Switch aux values, not the full-sequence
+            # statistic (the aux is quadratic in per-shard token stats) —
+            # the same per-shard routing approximation the data-sharded
+            # paths already make (models/moe.py capacity/routing notes):
+            # under sp, tokens route within their sequence shard, so the
+            # per-shard aux is the one that matches the routing actually
+            # performed. Dense configs (aux == 0) are exact; the pp x sp
+            # parity test covers dense.
+            aux_total = lax.pmean(aux_total, seq_axis)
         return y, aux_total
 
-    x_spec = P(data_axes if data_axes else None)
+    x_spec = P(
+        data_axes if data_axes else None,
+        seq_axis if seq_axis and sizes.get(seq_axis, 1) > 1 else None,
+    )
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     return jax.shard_map(
