@@ -19,7 +19,7 @@ sharding stays explicit.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -104,7 +104,6 @@ class TransformerConfig:
             return None
         if self.moe.d_ff:
             return self.moe
-        from dataclasses import replace
 
         return replace(self.moe, d_ff=self.d_ff)
 
@@ -513,7 +512,6 @@ def _pp_manual_layout(cfg: TransformerConfig, mesh):
         and cfg.kv_heads % tp == 0
         and (cfg.moe is not None or cfg.d_ff % tp == 0)
     ):
-        from dataclasses import replace
 
         tp_axis = "tp"
         cfg_stage = replace(
@@ -531,7 +529,6 @@ def _pp_manual_layout(cfg: TransformerConfig, mesh):
     if pp > 1 and cfg.seq_axis and sizes.get(cfg.seq_axis, 1) > 1:
         # sp INSIDE stages: activations arrive seq-sharded (pipeline_apply
         # seq_axis), the ring runs on the already-bound axis
-        from dataclasses import replace
 
         cfg_stage = replace(cfg_stage, seq_axis_bound=True)
     return tp_axis, gather_axes, cfg_stage

@@ -535,33 +535,37 @@ def test_interleaved_1f1b_transformer_parity():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
     ref_loss, _ = jax.value_and_grad(loss_fn)(params, {"tokens": tokens}, cfg)
 
-    pp_params = to_pp_params(params, 2, cfg, mesh, n_chunks=2)
-    specs = pp_param_specs(cfg, mesh, 2, n_chunks=2)
-    pp_params = jax.tree_util.tree_map(
-        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), pp_params, specs
-    )
-    batch = shard_batch(mesh, {"tokens": tokens})
-
-    g_loss, g_grads = jax.jit(jax.value_and_grad(
-        lambda p: pp_loss_fn(p, batch, cfg, mesh, n_micro=4, n_chunks=2)
-    ))(pp_params)
-    f_loss, f_grads = jax.jit(
-        lambda p, b: pp_1f1b_value_and_grad(
-            p, b, cfg, mesh, n_micro=4, n_chunks=2
+    # v=2 (4 layers/chunk) and v=4 (1 layer/chunk — deepest interleave of
+    # an 8-layer stack at S=2): the schedule tables generalize over v, the
+    # buffers stay O(S*v)
+    for v in (2, 4):
+        pp_params = to_pp_params(params, 2, cfg, mesh, n_chunks=v)
+        specs = pp_param_specs(cfg, mesh, 2, n_chunks=v)
+        pp_params = jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), pp_params, specs
         )
-    )(pp_params, batch)
-    jax.block_until_ready(f_loss)
+        batch = shard_batch(mesh, {"tokens": tokens})
 
-    assert np.allclose(float(f_loss), float(g_loss), atol=1e-6)
-    assert np.allclose(float(f_loss), float(ref_loss), atol=1e-5)
-    flat_g, _ = jax.tree_util.tree_flatten_with_path(g_grads)
-    flat_f, _ = jax.tree_util.tree_flatten_with_path(f_grads)
-    for (path_g, a), (path_f, b) in zip(flat_g, flat_f):
-        assert path_g == path_f
-        np.testing.assert_allclose(
-            np.asarray(b), np.asarray(a), atol=1e-6, rtol=1e-5,
-            err_msg=jax.tree_util.keystr(path_g),
-        )
+        g_loss, g_grads = jax.jit(jax.value_and_grad(
+            lambda p: pp_loss_fn(p, batch, cfg, mesh, n_micro=4, n_chunks=v)
+        ))(pp_params)
+        f_loss, f_grads = jax.jit(
+            lambda p, b: pp_1f1b_value_and_grad(
+                p, b, cfg, mesh, n_micro=4, n_chunks=v
+            )
+        )(pp_params, batch)
+        jax.block_until_ready(f_loss)
+
+        assert np.allclose(float(f_loss), float(g_loss), atol=1e-6), v
+        assert np.allclose(float(f_loss), float(ref_loss), atol=1e-5), v
+        flat_g, _ = jax.tree_util.tree_flatten_with_path(g_grads)
+        flat_f, _ = jax.tree_util.tree_flatten_with_path(f_grads)
+        for (path_g, a), (path_f, b) in zip(flat_g, flat_f):
+            assert path_g == path_f
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=1e-6, rtol=1e-5,
+                err_msg=f"v={v} {jax.tree_util.keystr(path_g)}",
+            )
 
 
 def test_pp_sp_ring_inside_stages():
