@@ -212,14 +212,14 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
     if cfg.seq_axis and cfg.seq_axis_bound:
         # inside an enclosing shard_map (pipeline stages): the sp axis name
         # is already bound, activations arrive seq-sharded — run the ring
-        # directly. Contiguous layout only: zigzag needs permuted batches,
-        # which the pipeline engines do not thread (parallel/pipeline.py
-        # module docstring records the boundary).
+        # directly. Zigzag works too: the permuted batch shards contiguously
+        # into exactly the [chunk r | chunk 2S-1-r] local layout the zigzag
+        # ring expects, and pp_forward derives the matching per-shard rope
+        # positions from the bound coordinate.
         if cfg.seq_layout == "zigzag":
-            raise ValueError(
-                'seq_layout="zigzag" is not composed with pipeline stages; '
-                'use the contiguous ring (seq_layout="contiguous") under pp'
-            )
+            from ..ops.ring_attention import ring_attention_zigzag
+
+            return ring_attention_zigzag(q, k, v, axis_name=cfg.seq_axis)
         return ring_attention(q, k, v, axis_name=cfg.seq_axis, causal=True)
     if cfg.seq_axis and mesh is not None:
         # ppermute needs bound axis names: run the ring under shard_map over
@@ -479,6 +479,15 @@ def loss_fn(params, batch, cfg: TransformerConfig, mesh=None):
     targets = batch.get("targets")
     mask = batch.get("loss_mask")  # optional with explicit targets
     if targets is None:
+        if cfg.seq_layout == "zigzag":
+            # rolling zigzag-ordered tokens yields STORAGE-order successors:
+            # wrong labels at every chunk boundary, and the fabricated
+            # last-position label would go unmasked. make_zigzag_batch
+            # supplies the correct natural-order targets + mask.
+            raise ValueError(
+                'seq_layout="zigzag" needs explicit batch targets/loss_mask '
+                "(models.make_zigzag_batch)"
+            )
         loss = next_token_ce(logits, tokens)
     else:
         loss = causal_ce(logits, targets, mask)
@@ -605,8 +614,20 @@ def pp_forward(
             # h is a sequence SHARD: rope/causal positions are the shard's
             # global offsets, derived from the bound sp coordinate
             local_s = h.shape[1]
-            start = lax.axis_index(cfg.seq_axis) * local_s
-            pos = (start + jnp.arange(local_s, dtype=jnp.int32))[None, :]
+            r = lax.axis_index(cfg.seq_axis)
+            if cfg.seq_layout == "zigzag":
+                # shard r stores natural chunks r and 2S-1-r back to back
+                # (ops/ring_attention.zigzag_permutation)
+                sp_n = lax.axis_size(cfg.seq_axis)
+                c = local_s // 2
+                ar = jnp.arange(c, dtype=jnp.int32)
+                pos = jnp.concatenate(
+                    [r * c + ar, (2 * sp_n - 1 - r) * c + ar]
+                )[None, :]
+            else:
+                pos = (r * local_s + jnp.arange(local_s, dtype=jnp.int32))[
+                    None, :
+                ]
         else:
             pos = positions
 
@@ -646,7 +667,21 @@ def pp_loss_fn(params, batch, cfg: TransformerConfig, mesh, n_micro: int = 4,
         params, tokens, cfg, mesh, n_micro=n_micro, with_aux=True,
         n_chunks=n_chunks,
     )
-    loss = next_token_ce(logits, tokens)
+    targets = batch.get("targets")
+    if targets is None:
+        if cfg.seq_layout == "zigzag":
+            # same hazard as loss_fn: storage-order roll mislabels every
+            # chunk boundary — zigzag batches must carry explicit targets
+            raise ValueError(
+                'seq_layout="zigzag" needs explicit batch targets/loss_mask '
+                "(models.make_zigzag_batch)"
+            )
+        loss = next_token_ce(logits, tokens)
+    else:
+        # explicit targets/mask (e.g. make_zigzag_batch for the zigzag ring
+        # inside stages; positions come from the bound sp coordinate, the
+        # batch's own "positions" entry is the non-pp path's input)
+        loss = causal_ce(logits, targets, batch.get("loss_mask"))
     if cfg.moe is not None:
         loss = loss + cfg.moe.router_aux_weight * aux / cfg.n_layers
     return loss
@@ -686,6 +721,15 @@ def pp_1f1b_value_and_grad(params, batch, cfg: TransformerConfig, mesh,
             "sp inside pipeline stages is composed with the GPipe schedule "
             "only (pp_loss_fn); the 1F1B engines do not thread sequence "
             "shards through their backward buffers"
+        )
+    if "targets" in batch:
+        # pp_loss_fn honors explicit targets/loss_mask; this engine's loss
+        # head is next-token CE over tokens — refuse rather than silently
+        # train a different objective than the GPipe schedule would
+        raise NotImplementedError(
+            "explicit batch targets/loss_mask are supported by the GPipe "
+            "schedule only (pp_loss_fn); the 1F1B loss head computes "
+            "next-token CE from tokens"
         )
     tp_axis, gather_axes, cfg_stage = _pp_manual_layout(cfg, mesh)
     ep_axis = "ep" if cfg.moe is not None else ""

@@ -24,12 +24,14 @@ elsewhere. Pipeline composes with the other axes:
 
 - **sp (ring attention) inside stages — GPipe schedule only**: pass
   `seq_axis="sp"` so activations shard (batch, seq/sp, d); the stage then
-  runs the contiguous ring on the already-bound axis
+  runs the ring on the already-bound axis
   (models/transformer._attention's seq_axis_bound path) with per-shard
-  rope positions derived from `lax.axis_index`. The 1F1B/interleaved
-  engines do not thread sequence shards through their backward buffers and
-  raise NotImplementedError; zigzag layout needs permuted batches the
-  engines don't thread — contiguous only.
+  rope positions derived from `lax.axis_index`. Both layouts compose:
+  contiguous, and zigzag (a `make_zigzag_batch` batch shards contiguously
+  into exactly the [chunk r | chunk 2S-1-r] local layout the zigzag ring
+  expects; pp_loss_fn honors its explicit targets/loss_mask). The
+  1F1B/interleaved engines do not thread sequence shards through their
+  backward buffers and raise NotImplementedError.
 
 Everything (ppermute, masked scatter, psum broadcast) is differentiable, so
 the same function trains.

@@ -569,12 +569,14 @@ def test_interleaved_1f1b_transformer_parity():
 
 
 def test_pp_sp_ring_inside_stages():
-    """Long-context x pipeline: GPipe stages run CONTIGUOUS ring attention
-    on sequence shards (pipeline_apply seq_axis + _attention's
-    seq_axis_bound path, per-shard rope positions from the bound sp
-    coordinate). Loss and every gradient leaf match the non-pipelined
-    single-device model, at pp x sp x fsdp AND pp x sp x tp; the 1F1B
-    engines refuse the composition explicitly."""
+    """Long-context x pipeline: GPipe stages run ring attention on sequence
+    shards (pipeline_apply seq_axis + _attention's seq_axis_bound path,
+    per-shard rope positions from the bound sp coordinate) — contiguous at
+    pp x sp x fsdp AND pp x sp x tp, zigzag (make_zigzag_batch sharding
+    contiguously into the zigzag ring's local layout, explicit targets +
+    loss_mask through pp_loss_fn). Loss and every gradient leaf match the
+    non-pipelined single-device model; the 1F1B engines refuse the
+    composition explicitly."""
     import numpy as np
     import pytest
     from jax.sharding import NamedSharding
@@ -632,3 +634,32 @@ def test_pp_sp_ring_inside_stages():
 
         with pytest.raises(NotImplementedError):
             pp_1f1b_value_and_grad(pp_params, batch, cfg, mesh, n_micro=2)
+
+    # zigzag layout: the permuted batch shards contiguously into the
+    # zigzag ring's [chunk r | chunk 2S-1-r] local layout; CE runs on the
+    # batch's explicit targets/loss_mask and equals the natural-order loss
+    # EXACTLY (make_zigzag_batch contract)
+    from odh_kubeflow_tpu.models.transformer import make_zigzag_batch
+
+    cfg_zz = TransformerConfig(seq_axis="sp", seq_layout="zigzag", **base)
+    mesh = MeshPlan(fsdp=2, pp=2, sp=2).build(jax.devices()[:8])
+    pp_params = to_pp_params(params, 2, cfg_zz, mesh)
+    specs = pp_param_specs(cfg_zz, mesh, 2)
+    pp_params = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        pp_params, specs,
+    )
+    batch = shard_batch(mesh, dict(make_zigzag_batch(tokens, sp=2)))
+    loss, g = jax.jit(
+        lambda p, b: jax.value_and_grad(pp_loss_fn)(p, b, cfg_zz, mesh, n_micro=2)
+    )(pp_params, batch)
+    assert np.allclose(float(loss), float(ref_loss), atol=1e-5)
+    ref_pp_g = to_pp_params(ref_g, 2, cfg_zz, mesh)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(g)[0],
+        jax.tree_util.tree_flatten_with_path(ref_pp_g)[0],
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
+            err_msg=f"zigzag {jax.tree_util.keystr(pa)}",
+        )
