@@ -607,6 +607,12 @@ def pp_forward(
     sp_live = cfg_stage.seq_axis_bound  # set by _pp_manual_layout: sp > 1
 
     table = params["embed"].astype(cfg.dtype)
+    # same gather discipline as forward(): replicate the (embed-dim-stored)
+    # table before gathering so XLA never hits its "involuntary full
+    # rematerialization" path for a sharded-operand gather
+    table = lax.with_sharding_constraint(
+        table, jax.sharding.NamedSharding(mesh, logical_to_spec((None, None), mesh))
+    )
     x = table[tokens]
 
     def stage_fn(stage_layers, h):
