@@ -190,6 +190,14 @@ class Pod(KubeObject):
     spec: PodSpec = field(default_factory=PodSpec)
     status: PodStatus = field(default_factory=PodStatus)
 
+    def is_ready(self) -> bool:
+        """Pod Ready condition is True (the single definition every
+        controller shares — kubelet sim, STS status, probe gate, culler)."""
+        return any(
+            c.type == "Ready" and c.status == "True"
+            for c in self.status.conditions
+        )
+
 
 @dataclass
 class ServicePort(KubeModel):

@@ -172,9 +172,7 @@ class Kubelet:
                 with self._lock:
                     self._servers[key] = (pod.metadata.uid, host, port, close)
 
-        if pod.status.phase == "Running" and any(
-            c.type == "Ready" and c.status == "True" for c in pod.status.conditions
-        ):
+        if pod.status.phase == "Running" and pod.is_ready():
             return None
         pod.status.phase = "Running"
         pod.status.pod_ip = pod.status.pod_ip or f"10.1.{next(_ip_seq) % 250}.{next(_ip_seq) % 250}"

@@ -84,7 +84,7 @@ class StatefulSetController:
                 )
                 continue
             running += 1
-            if any(c.type == "Ready" and c.status == "True" for c in pod.status.conditions):
+            if pod.is_ready():
                 ready += 1
 
         # scale down: delete pods with ordinal >= desired (and strays)

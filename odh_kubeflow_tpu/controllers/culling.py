@@ -146,6 +146,11 @@ class CullingReconciler:
             reached += 1
             if float(data.get("duty_cycle", 0.0)) > self.config.tpu_idle_threshold:
                 busy = True
+            if data.get("warming"):
+                # the monitor does not yet have a full observation window:
+                # no idleness verdict — treat as busy rather than cull a
+                # notebook during probe bring-up
+                busy = True
             ts = data.get("last_busy", "")
             if ts:
                 try:
@@ -175,13 +180,33 @@ class CullingReconciler:
             self._remove_activity_annotations(nb)
             return None
 
-        # pod 0 gone: nothing to probe (reference :120-135)
+        # pod 0 gone, going, or not yet Ready: nothing to probe (reference
+        # :120-135, strengthened). Idleness is only measurable on a READY
+        # pod: a terminating pod's server answers probes for seconds after
+        # deletion, and a Pending replacement can be probed THROUGH stale
+        # Service endpoints still pointing at the previous incarnation —
+        # either way the culler would judge a notebook idle while its real
+        # pod hasn't started, re-cull it, and the stop annotation then
+        # blocks the recreate forever (a level-triggering deadlock observed
+        # under CPU starvation with sub-second cull thresholds; unreachable
+        # at the reference's minute-scale thresholds, but the state machine
+        # should not depend on that).
         try:
-            self.api_reader.get(
+            pod0 = self.api_reader.get(
                 Pod, nb.metadata.namespace, f"{statefulset_name(nb.metadata.name)}-0"
             )
+            if pod0.metadata.deletion_timestamp:
+                raise NotFoundError("pod terminating")
         except NotFoundError:
             self._remove_activity_annotations(nb)
+            return Result(requeue_after=period_s)
+        if not pod0.is_ready():
+            # exists but not Ready (starting, or a readiness flap): skip the
+            # probe — KEEPING the annotations, so a flapping-but-idle
+            # notebook's idle clock is not reset — and come back. Probing
+            # here can hit stale Service endpoints still pointing at the
+            # previous incarnation's server and judge a pod idle before it
+            # has started.
             return Result(requeue_after=period_s)
 
         # first sight: initialize the annotation state machine (reference :141-153)

@@ -416,11 +416,7 @@ class NotebookReconciler:
             )
             if not p.metadata.deletion_timestamp
         ]
-        ready_pods = sum(
-            1
-            for p in pods
-            if any(c.type == "Ready" and c.status == "True" for c in p.status.conditions)
-        )
+        ready_pods = sum(1 for p in pods if p.is_ready())
 
         before = nb.status.to_dict()  # pre-mutation snapshot for the no-op check
         status = nb.status

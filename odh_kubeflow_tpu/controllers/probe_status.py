@@ -132,11 +132,7 @@ class ProbeStatusController:
             )
             if not p.metadata.deletion_timestamp
         ]
-        ready_pods = sum(
-            1
-            for p in pods
-            if any(c.type == "Ready" and c.status == "True" for c in p.status.conditions)
-        )
+        ready_pods = sum(1 for p in pods if p.is_ready())
 
         tpu_pub = nb.status.tpu
         if ready_pods < shape.hosts and not (

@@ -52,6 +52,13 @@ class TPUMonitor:
         """Unix timestamp of last observed TPU activity."""
         raise NotImplementedError
 
+    def warming(self) -> bool:
+        """True while the monitor does not yet have a full observation
+        window of evidence — consumers must not treat the notebook as idle
+        on a warming signal. Default False: monitors whose signal is valid
+        from the first read (sim, scraped runtime metrics)."""
+        return False
+
 
 class JaxTPUMonitor(TPUMonitor):
     """Real implementation: introspects the local JAX/TPU runtime.
@@ -89,7 +96,19 @@ class JaxTPUMonitor(TPUMonitor):
         self._process_id = int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
         self._window_s = window_s
         self._activity: List[Tuple[float, float]] = []  # (timestamp, busy seconds)
-        self._last_busy = 0.0
+        # Bring-up counts as activity: a monitor cannot certify idleness it
+        # has not observed, so last_busy starts at construction time rather
+        # than 0 ("idle since epoch"). Without this, an aggressive culler
+        # can kill a busy notebook in the race between pod-ready and the
+        # sampler's first detected activity (seen once in 9 suite runs
+        # under CPU starvation). Reference analog: the culler initializes
+        # absent last-activity annotations to NOW before judging idleness
+        # (culling_controller.go:141-153).
+        self._last_busy = time.time()
+        # set by start_sampling; warming() is True until a full window has
+        # elapsed since then — the monitor refuses an idleness verdict
+        # before one window of evidence exists
+        self._sampling_since: Optional[float] = None
         self._lock = threading.Lock()
         if metrics_port is None:
             ports = os.environ.get("TPU_RUNTIME_METRICS_PORTS", "")
@@ -153,6 +172,8 @@ class JaxTPUMonitor(TPUMonitor):
         """Start the background runtime-state sampler (idempotent)."""
         if self._sampler is not None and self._sampler.is_alive():
             return
+        if self._sampling_since is None:
+            self._sampling_since = time.time()
         self._sampler_stop.clear()
 
         def run() -> None:
@@ -241,6 +262,16 @@ class JaxTPUMonitor(TPUMonitor):
         with self._lock:
             return self._last_busy
 
+    def warming(self) -> bool:
+        # no idleness verdict before one full window of samples: under CPU
+        # starvation the sampler's first detection can land arbitrarily
+        # late, and an aggressive culler would otherwise kill a busy
+        # notebook during bring-up (phase-1 flake of
+        # test_plain_jax_busy_loop_survives_aggressive_culler, 2 of 10
+        # full-suite runs)
+        since = self._sampling_since
+        return since is None or (time.time() - since) < self._window_s
+
 
 def parse_duty_cycle_metrics(text: str) -> Optional[float]:
     """Extract a 0..1 duty cycle from Prometheus exposition text: the max of
@@ -322,6 +353,9 @@ class NotebookAgent:
         self.kernels = kernels or KernelState()
         self.base_path = base_path.rstrip("/")
         self._server: Optional[ThreadingHTTPServer] = None
+        self._serve_lock = threading.Lock()
+        self._closed = False
+        self._last_port = 0
 
     def routes(self, path: str) -> Optional[Dict[str, Any]]:
         if self.base_path and path.startswith(self.base_path):
@@ -345,6 +379,7 @@ class NotebookAgent:
             return {
                 "duty_cycle": self.monitor.duty_cycle(),
                 "last_busy": _utc(lb) if lb else "",
+                "warming": self.monitor.warming(),
             }
         if path.endswith("/healthz"):
             return {"status": "ok"}
@@ -352,10 +387,6 @@ class NotebookAgent:
 
     def serve(self, host: str = "127.0.0.1", port: int = 0):
         agent = self
-        # measured duty cycle by default: monitors that can sample runtime
-        # state do so from the moment the probe is serving
-        if hasattr(self.monitor, "start_sampling"):
-            self.monitor.start_sampling()
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
@@ -375,16 +406,47 @@ class NotebookAgent:
             def log_message(self, *args: Any) -> None:
                 pass
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        # Race-safe and idempotent against a concurrent/earlier close():
+        # - a live agent returns its existing endpoint (no duplicate servers
+        #   when the kubelet sim retries a reconcile),
+        # - a CLOSED agent stays closed — it returns the last (now dead)
+        #   port so probes get connection-refused, mirroring a crashed
+        #   in-pod probe process. The old code re-read self._server after
+        #   releasing no lock: close() between the assignment and the
+        #   server_port read crashed the kubelet reconcile (AttributeError),
+        #   and the backoff RETRY then re-opened the closed probe —
+        #   observed as test_unreachable_probe_keeps_gate_closed reporting
+        #   mesh_ready=True under CPU starvation.
+        with self._serve_lock:
+            if self._closed:
+                return (host, self._last_port or 1, self.close)
+            if self._server is not None:
+                return (host, self._server.server_port, self.close)
+            server = ThreadingHTTPServer((host, port), Handler)
+            self._server = server
+            self._last_port = server.server_port
+        # measured duty cycle by default: monitors that can sample runtime
+        # state do so from the moment the probe is serving (and only for a
+        # genuinely started server — a closed agent must not spin samplers)
+        if hasattr(self.monitor, "start_sampling"):
+            self.monitor.start_sampling()
         threading.Thread(
-            target=self._server.serve_forever, name="notebook-agent", daemon=True
+            target=server.serve_forever, name="notebook-agent", daemon=True
         ).start()
-        return (host, self._server.server_port, self.close)
+        return (host, server.server_port, self.close)
 
     def close(self) -> None:
-        if self._server is not None:
-            self._server.shutdown()
-            self._server = None
+        with self._serve_lock:
+            server, self._server = self._server, None
+            self._closed = True
+        if hasattr(self.monitor, "stop_sampling"):
+            self.monitor.stop_sampling()  # symmetric with serve()'s start
+        if server is not None:
+            server.shutdown()
+            # server_close() releases the listening socket: probes to the
+            # old port must fail fast (ECONNREFUSED), not complete a
+            # handshake against a half-dead listener and hang to timeout
+            server.server_close()
 
 
 def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,

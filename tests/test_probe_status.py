@@ -164,7 +164,17 @@ def test_unreachable_probe_keeps_gate_closed(env):
         lambda: get_nb(cluster, "mute").status.ready_replicas == 1,
         msg="pod ready",
     )
-    time.sleep(1.0)  # several probe periods
+    # condition-wait, not a fixed sleep: if the probe controller sampled the
+    # agent in the instant before close(), mesh_ready may flash True — the
+    # contract is that an unreachable probe CLOSES the gate within a probe
+    # cycle, i.e. the gate is eventually (and then stably) closed
+    wait_for(
+        lambda: (
+            lambda t: t is None or t.mesh_ready is False
+        )(get_nb(cluster, "mute").status.tpu),
+        timeout=20, msg="gate closed with probe unreachable",
+    )
+    time.sleep(0.5)  # several probe periods: stays closed
     tpu = get_nb(cluster, "mute").status.tpu
     assert tpu is None or tpu.mesh_ready is False
 
