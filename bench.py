@@ -5,8 +5,9 @@ Two halves:
 1. TPU compute (runs when a TPU is attached — the driver's bench host):
    - pallas flash-attention kernel vs the XLA reference attention
      (ops/attention.py mha_reference) at 2k/4k bf16: wall time, achieved
-     TFLOP/s, MFU, speedup (the VERDICT-r1 `speedup_vs_reference` /
-     `kernel_mfu` acceptance numbers),
+     TFLOP/s, MFU, speedup (reported as `flash_vs_xla_attention_4k` — it is
+     a KERNEL-vs-XLA-attention number, not a framework-vs-framework one;
+     plus the VERDICT-r1 `kernel_mfu` acceptance number),
    - long-context: flash at 8k seq, where the score-materializing path
      cannot run at all on one chip,
    - flagship train step (models/transformer.py + make_train_step):
@@ -28,9 +29,13 @@ Two halves:
    an in-process sim latency, NOT comparable to a live-cluster number (the
    reference publishes no benchmarks at all, SURVEY §6).
 
-vs_baseline for the headline metric is the measured kernel speedup over the
-XLA reference implementation of the same op — the baseline a JAX user gets
-without the pallas kernel.
+vs_baseline for the headline metric is 1.0 by construction: the reference
+framework publishes no comparable training-throughput number, so there is no
+framework-vs-framework speedup to report. The measured kernel speedup over
+the XLA reference implementation of the same op (the baseline a JAX user
+gets without the pallas kernel) is reported separately and explicitly as
+`flash_vs_xla_attention_4k` — earlier artifacts surfaced it as a top-level
+`speedup_vs_reference`, which read as a framework comparison it never was.
 """
 from __future__ import annotations
 
@@ -104,7 +109,6 @@ def bench_kernels():
         )
         return t, flops
 
-    best_speedup = 0.0
     # vs the XLA reference attention at sizes where it still compiles
     for tag, (b, s, h), n2 in (("2k", (4, 2048, 8), 400), ("4k", (4, 4096, 8), 150)):
         q, k, v = qkv(b, s, h, h)
@@ -120,7 +124,6 @@ def bench_kernels():
             "mfu": round(flops / t_flash / V5E_PEAK_FLOPS, 3),
             "speedup": round(t_ref / t_flash, 2),
         }
-        best_speedup = max(best_speedup, t_ref / t_flash)
 
     # compute-bound points: 8k (the materializing path cannot run at all on
     # one chip), 8k grouped-query (K/V streamed at kv_heads width — the
@@ -183,7 +186,10 @@ def bench_kernels():
         lambda x, w: (x @ w).astype(jnp.bfloat16), (a, a), fetch, n2=110
     )
     mm_tflops = 2 * m**3 / t_mm / 1e12
-    out["speedup_vs_reference"] = round(best_speedup, 2)
+    # explicit name: this is the flash KERNEL vs XLA's attention at the
+    # largest size both compile (4k), not a framework-vs-framework speedup —
+    # and it is the 4k POINT, not the best across sizes
+    out["flash_vs_xla_attention_4k"] = out["4k"]["speedup"]
     # headline MFU from the compute-bound 8k point, NOT the dispatch-floored
     # small sizes
     out["kernel_mfu"] = out["8k"]["mfu"]
@@ -827,9 +833,11 @@ def main() -> None:
             "metric": "train_step_tokens_per_s_v5e1",
             "value": train["tokens_per_s"],
             "unit": "tokens/s",
-            # baseline = the same ops via XLA reference attention
-            "vs_baseline": kernels["speedup_vs_reference"],
-            "speedup_vs_reference": kernels["speedup_vs_reference"],
+            # no comparable published framework number exists; the kernel
+            # speedup is reported under its own honest name, never as the
+            # headline metric's baseline ratio
+            "vs_baseline": 1.0,
+            "flash_vs_xla_attention_4k": kernels["flash_vs_xla_attention_4k"],
             "kernel_mfu": kernels["kernel_mfu"],
             "detail": detail,
         }

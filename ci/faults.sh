@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Fault-injection CI lane: the deterministic fault tests (tests/test_faults.py,
+# marker `faults`), rerun in a stress loop to flush out flaky recovery paths.
+#
+# Recovery code is exactly the code whose bugs hide behind timing: a watch
+# re-establishment that loses an event only fails when the drop lands in a
+# 10ms window. One green run proves little; N consecutive green runs with a
+# pinned hash seed (dict iteration order stable across runs) is the lane's
+# actual signal. The injection schedules themselves are seeded/counted —
+# no wall-clock randomness — so a failure here reproduces locally with the
+# same command.
+#
+#   ./ci/faults.sh            # default: 3 iterations
+#   FAULTS_REPEAT=10 ./ci/faults.sh
+#   FAULTS_REPEAT=1 ./ci/faults.sh -k watch   # forward extra pytest args
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+REPEAT="${FAULTS_REPEAT:-3}"
+export PYTHONHASHSEED="${PYTHONHASHSEED:-0}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+for i in $(seq 1 "$REPEAT"); do
+    echo "=== faults lane: iteration $i/$REPEAT (PYTHONHASHSEED=$PYTHONHASHSEED) ==="
+    python -m pytest tests/test_faults.py -q -m "faults and not slow" \
+        -p no:cacheprovider -p no:randomly "$@"
+done
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green ==="

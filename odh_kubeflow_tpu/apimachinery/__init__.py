@@ -7,6 +7,7 @@ from .errors import (
     GoneError,
     InvalidError,
     NotFoundError,
+    TooManyRequestsError,
     UnauthorizedError,
     ignore_not_found,
     is_already_exists,

@@ -59,6 +59,19 @@ class UnauthorizedError(ApiError):
     reason = "Unauthorized"
 
 
+class TooManyRequestsError(ApiError):
+    """API priority-and-fairness / client throttling rejection (HTTP 429).
+    Carries the server's suggested Retry-After so clients can honor it
+    (kube-apiserver puts it in Status.details.retryAfterSeconds)."""
+
+    code = 429
+    reason = "TooManyRequests"
+
+    def __init__(self, message: str = "", *, retry_after: float = 1.0, **kw):
+        super().__init__(message, **kw)
+        self.retry_after = retry_after
+
+
 class AdmissionDeniedError(ApiError):
     """A mutating/validating webhook rejected the request (failurePolicy: Fail)."""
 

@@ -2,6 +2,7 @@ from .client import Client, retry_on_conflict
 from .store import (
     ADDED,
     DELETED,
+    DROPPED,
     MODIFIED,
     AdmissionRequest,
     Store,
@@ -10,6 +11,7 @@ from .store import (
     register_storage_alias,
 )
 from .apiserver import ApiServer, parse_label_selector
+from .faults import FaultInjector, FaultRule, seeded_bad_day
 from .kubelet import Behavior, Kubelet, PodDecision
 from .remote import RemoteStore, RemoteWatch
 from .webhook_dispatch import WebhookDispatcher

@@ -70,18 +70,23 @@ class _Route:
         self.namespaced = namespaced
 
 
-def _status_body(code: int, reason: str, message: str) -> bytes:
-    return json.dumps(
-        {
-            "kind": "Status",
-            "apiVersion": "v1",
-            "metadata": {},
-            "status": "Failure",
-            "message": message,
-            "reason": reason,
-            "code": code,
-        }
-    ).encode()
+def _status_body(
+    code: int, reason: str, message: str, retry_after: Optional[float] = None
+) -> bytes:
+    body: Dict[str, Any] = {
+        "kind": "Status",
+        "apiVersion": "v1",
+        "metadata": {},
+        "status": "Failure",
+        "message": message,
+        "reason": reason,
+        "code": code,
+    }
+    if retry_after is not None:
+        # kube-apiserver's throttling shape: Status.details.retryAfterSeconds
+        # (clients honor it like the Retry-After header)
+        body["details"] = {"retryAfterSeconds": retry_after}
+    return json.dumps(body).encode()
 
 
 def parse_label_selector(raw: str) -> Optional[Dict[str, str]]:
@@ -215,9 +220,15 @@ class ApiServer:
         # the log reflects completed requests). Verb handlers therefore
         # RETURN (code, body) instead of writing to the socket; the one
         # streaming verb (watch) audits at stream start.
+        h._body_consumed = False  # per-request: handlers persist on keep-alive
         try:
             if not self._authorized(h):
                 raise UnauthorizedError("missing or invalid bearer token")
+            faults = getattr(self.store, "faults", None)
+            if faults is not None:
+                # API priority & fairness rejection point: a matching rule
+                # answers 429 + Retry-After before any dispatch work
+                faults.check("apiserver.request", method=method, path=h.path)
             parsed = urlparse(h.path)
             query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
             route = self._parse_path(parsed.path)
@@ -310,6 +321,7 @@ class ApiServer:
     def _read_body(self, h: BaseHTTPRequestHandler) -> Dict[str, Any]:
         length = int(h.headers.get("Content-Length", "0"))
         raw = h.rfile.read(length) if length else b""
+        h._body_consumed = True
         if not raw:
             raise InvalidError("request body required")
         try:
@@ -324,7 +336,33 @@ class ApiServer:
         respond(h, code, json.dumps(obj).encode())
 
     def _send_status_error(self, h: BaseHTTPRequestHandler, e: ApiError) -> None:
-        respond(h, e.code, _status_body(e.code, e.reason, str(e)))
+        retry_after = getattr(e, "retry_after", None)
+        body = _status_body(e.code, e.reason, str(e), retry_after=retry_after)
+        # An error raised BEFORE the verb handler read the request body
+        # (auth failure, injected 429) leaves those bytes on the socket; on
+        # a keep-alive connection the next request parse would start inside
+        # them. Close the connection and say so — http.client sees the
+        # header and transparently reopens for the retry.
+        unread_body = (
+            h.command in ("POST", "PUT", "PATCH")
+            and int(h.headers.get("Content-Length") or 0) > 0
+            and not getattr(h, "_body_consumed", False)
+        )
+        if retry_after is None and not unread_body:
+            respond(h, e.code, body)
+            return
+        # manual framing to add the extra headers (respond() owns only the
+        # framing headers)
+        h.send_response(e.code)
+        h.send_header("Content-Type", "application/json")
+        if retry_after is not None:
+            h.send_header("Retry-After", str(max(1, int(retry_after))))
+        if unread_body:
+            h.send_header("Connection", "close")
+            h.close_connection = True
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
 
     # -- verbs --
 
@@ -494,6 +532,11 @@ class ApiServer:
                         idle_polls = 0
                     continue
                 idle_polls = 0
+                if ev.type == "DROPPED":
+                    # injected stream severing: end the chunked response so
+                    # the remote reflector reconnects from its last RV —
+                    # exactly what a dropped apiserver connection looks like
+                    break
                 if ev.type == "BOOKMARK":
                     if not bookmarks:
                         continue

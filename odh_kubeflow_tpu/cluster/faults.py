@@ -1,0 +1,251 @@
+"""Fault injection for the sim control plane.
+
+The reference inherits its resilience from client-go/controller-runtime and
+never has to prove it; this build's runtime (runtime/informer.py,
+runtime/cached_client.py, cluster/remote.py) is reimplemented from scratch,
+so its recovery behavior is exercised explicitly: a `FaultInjector` that the
+Store, the sim ApiServer, the kubelet, the webhook dispatcher, and the sim's
+cluster DNS all consult at named fault sites. Tests (tests/test_faults.py)
+script rules against those sites and assert the cluster still converges.
+
+Design constraints:
+- **Deterministic.** Rules fire on call counts ("the next N updates of
+  Notebook conflict"), never on wall-clock timers or unseeded randomness.
+  The seeded "bad day" schedule derives every count from random.Random(seed).
+- **Zero-cost when idle.** Every hook site is `if faults is not None` on a
+  plain attribute; a store without an injector pays one identity check.
+- **Layered like production faults.** Injection happens at the boundary the
+  real failure would occur at: watch severing at the store's subscriber
+  queues (a dropped TCP stream), 410 at watch-resume (trimmed watch cache),
+  429 at request admission (API priority & fairness), webhook faults at the
+  dispatcher's callout, crashes at the kubelet, partitions at cluster DNS.
+
+Fault sites (the `site` strings components consult):
+- ``store.read``          GET/LIST against the store (ctx: kind)
+- ``store.write``         create/update/patch/delete (ctx: kind, obj)
+- ``store.watch_resume``  a watch resuming from a resourceVersion (ctx: kind)
+- ``apiserver.request``   every HTTP request before dispatch (ctx: method, path)
+- ``webhook.call``        the dispatcher's AdmissionReview POST (ctx: name, url)
+- ``kubelet.pod``         each kubelet reconcile (ctx: namespace, name, obj) —
+  action rules here ("crash") are *decided*, not raised
+- ``probe.http``          the sim cluster-DNS HTTP transport (ctx: host, url)
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ..apimachinery import ConflictError, GoneError, TooManyRequestsError
+
+
+@dataclass
+class FaultRule:
+    """One scripted fault: fires at a site while its budget lasts.
+
+    `times=None` keeps firing until the rule is removed; an exhausted rule
+    stays registered (fired == times) so tests can assert how often it hit.
+    """
+
+    site: str
+    error: Optional[Callable[[], Exception]] = None  # raise-on-match
+    action: str = ""  # non-raising verdict ("crash", "partition")
+    kind: Optional[str] = None  # match ctx["kind"]
+    name: Optional[str] = None  # substring match on ctx name/host/url
+    times: Optional[int] = None  # budget; None = unlimited
+    match: Optional[Callable[[Dict[str, Any]], bool]] = None  # extra predicate
+    fired: int = 0
+
+    def _matches(self, site: str, ctx: Dict[str, Any]) -> bool:
+        if site != self.site:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.kind is not None and ctx.get("kind") != self.kind:
+            return False
+        if self.name is not None:
+            hay = str(
+                ctx.get("name") or ctx.get("host") or ctx.get("url") or ""
+            )
+            if self.name not in hay:
+                return False
+        if self.match is not None and not self.match(ctx):
+            return False
+        return True
+
+    @property
+    def exhausted(self) -> bool:
+        return self.times is not None and self.fired >= self.times
+
+
+class FaultInjector:
+    """Registry of FaultRules plus active operations (watch severing).
+
+    Components hold a reference and call `check(site, **ctx)` (raises the
+    first matching rule's error) or `decide(site, **ctx)` (returns the
+    matching action rule, for sites where the component — not an exception —
+    implements the fault, e.g. the kubelet's crash-restart).
+    """
+
+    def __init__(self, seed: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._rules: List[FaultRule] = []
+        self.rng = random.Random(seed)
+        self._stores: List[Any] = []  # bound Stores, for sever_watches
+
+    # -- rule management --
+
+    def add(self, rule: FaultRule) -> FaultRule:
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def remove(self, rule: FaultRule) -> None:
+        with self._lock:
+            try:
+                self._rules.remove(rule)
+            except ValueError:
+                pass
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def rules(self) -> List[FaultRule]:
+        with self._lock:
+            return list(self._rules)
+
+    # -- consult sites --
+
+    def check(self, site: str, **ctx: Any) -> None:
+        """Raise the first matching error rule (consuming one firing)."""
+        err: Optional[Exception] = None
+        with self._lock:
+            for rule in self._rules:
+                if rule.error is not None and rule._matches(site, ctx):
+                    rule.fired += 1
+                    err = rule.error()
+                    break
+        if err is not None:
+            raise err
+
+    def decide(self, site: str, **ctx: Any) -> Optional[FaultRule]:
+        """Return the first matching action rule (consuming one firing)."""
+        with self._lock:
+            for rule in self._rules:
+                if rule.action and rule._matches(site, ctx):
+                    rule.fired += 1
+                    return rule
+        return None
+
+    # -- active operations --
+
+    def bind_store(self, store: Any) -> None:
+        """Register a Store so drop_watches can sever its streams."""
+        with self._lock:
+            if store not in self._stores:
+                self._stores.append(store)
+
+    def drop_watches(self, api_version: Optional[str] = None,
+                     kind: Optional[str] = None) -> int:
+        """Sever matching live watch streams on every bound store — the
+        network-level analog of an apiserver closing watch connections.
+        Returns the number of subscriber queues severed."""
+        with self._lock:
+            stores = list(self._stores)
+        severed = 0
+        for store in stores:
+            severed += store.sever_watches(api_version=api_version, kind=kind)
+        return severed
+
+    # -- scripted fault constructors --
+
+    def conflict_storm(self, kind: str, times: int = 3) -> FaultRule:
+        """The next `times` UPDATEs of `kind` fail with 409 Conflict
+        (optimistic-concurrency conflicts only exist on updates — a create
+        can 409 AlreadyExists, never Conflict)."""
+        return self.add(FaultRule(
+            site="store.write", kind=kind, times=times,
+            match=lambda ctx: ctx.get("verb") == "update",
+            error=lambda: ConflictError(
+                f"injected conflict storm on {kind}"),
+        ))
+
+    def throttle(self, times: int = 5, retry_after: float = 0.05,
+                 kind: Optional[str] = None, writes_only: bool = False,
+                 match: Optional[Callable[[Dict[str, Any]], bool]] = None,
+                 ) -> List[FaultRule]:
+        """429 + Retry-After on the next `times` store operations."""
+        def err() -> Exception:
+            return TooManyRequestsError(
+                "injected throttle", retry_after=retry_after)
+
+        sites = ["store.write"] if writes_only else ["store.write", "store.read"]
+        return [
+            self.add(FaultRule(site=s, kind=kind, times=times, error=err,
+                               match=match))
+            for s in sites
+        ]
+
+    def expire_watch(self, kind: Optional[str] = None,
+                     times: int = 1) -> FaultRule:
+        """The next `times` watch resumes answer 410 Expired — forces the
+        informer/reflector relist path regardless of history depth."""
+        return self.add(FaultRule(
+            site="store.watch_resume", kind=kind, times=times,
+            error=lambda: GoneError("injected: too old resource version"),
+        ))
+
+    def webhook_outage(self, name: Optional[str] = None,
+                       times: int = 3, mode: str = "timeout") -> FaultRule:
+        """The dispatcher's next `times` webhook callouts fail before the
+        POST — `timeout` (socket timeout) or `error` (connection refused)."""
+        import socket
+
+        def err() -> Exception:
+            if mode == "timeout":
+                return socket.timeout("injected webhook timeout")
+            return ConnectionError("injected webhook connection failure")
+
+        return self.add(FaultRule(
+            site="webhook.call", name=name, times=times, error=err))
+
+    def crash_pod(self, name: str, restarts: int = 1) -> FaultRule:
+        """The kubelet crash-restarts matching pods: container goes
+        not-ready (CrashLoopBackOff, restartCount++), its server dies, and
+        after `restarts` firings the pod comes back up."""
+        return self.add(FaultRule(
+            site="kubelet.pod", name=name, times=restarts, action="crash"))
+
+    def partition_probe(self, host: Optional[str] = None,
+                        times: Optional[int] = None) -> FaultRule:
+        """Cluster-DNS HTTP requests to matching hosts fail — the probe
+        agent's network partition. times=None holds the partition until the
+        rule is removed (heal by `injector.remove(rule)`)."""
+        return self.add(FaultRule(
+            site="probe.http", name=host, times=times,
+            error=lambda: ConnectionError("injected network partition"),
+        ))
+
+
+def seeded_bad_day(injector: FaultInjector, seed: int,
+                   kind: str = "Notebook") -> List[FaultRule]:
+    """A deterministic combined fault schedule: every budget is drawn from
+    random.Random(seed), so two runs with the same seed inject the identical
+    fault set. Watch drops are count-scheduled by the caller (the test loop
+    calls injector.drop_watches between convergence waits) — nothing here
+    fires on wall-clock time."""
+    rng = random.Random(seed)
+    rules = [
+        injector.conflict_storm(kind, times=rng.randint(2, 6)),
+        # throttle everything except creates: the scenario driver's own
+        # object creation must enter the system so recovery has work to do
+        *injector.throttle(times=rng.randint(3, 8),
+                           retry_after=0.02 * rng.randint(1, 3),
+                           match=lambda ctx: ctx.get("verb") != "create"),
+        injector.expire_watch(times=rng.randint(1, 3)),
+        injector.webhook_outage(times=rng.randint(1, 4), mode="timeout"),
+        injector.partition_probe(times=rng.randint(2, 5)),
+    ]
+    return rules

@@ -53,7 +53,12 @@ class Kubelet:
     def server_for(self, namespace: str, pod_name: str) -> Optional[Tuple[str, int]]:
         with self._lock:
             entry = self._servers.get(f"{namespace}/{pod_name}")
-            return (entry[1], entry[2]) if entry else None
+            # port 0 is the closed-agent sentinel (probe/agent.py serve()):
+            # a dead in-pod server must resolve as unreachable, never as a
+            # stale (possibly OS-reused) ephemeral port
+            if entry is None or not entry[2]:
+                return None
+            return (entry[1], entry[2])
 
     def _drop_state(self, key: str, expect_uid: Optional[str] = None) -> None:
         """Clear per-pod state (closing any server). With expect_uid, only
@@ -108,6 +113,14 @@ class Kubelet:
 
         decision = self._decide(pod)
         key = req.key
+
+        faults = getattr(self.manager.store, "faults", None)
+        if faults is not None:
+            rule = faults.decide("kubelet.pod", namespace=req.namespace,
+                                 name=req.name, obj=pod)
+            if rule is not None and rule.action == "crash":
+                self._crash(pod, key)
+                return Result(requeue_after=0.05)
 
         if decision.fail:
             already_failed = (
@@ -169,17 +182,33 @@ class Kubelet:
                 result = decision.serve(pod)
                 host, port = result[0], result[1]
                 close = result[2] if len(result) > 2 else None
-                with self._lock:
-                    self._servers[key] = (pod.metadata.uid, host, port, close)
+                if port:
+                    with self._lock:
+                        self._servers[key] = (pod.metadata.uid, host, port, close)
+                else:
+                    # port 0: the agent is permanently closed (crashed probe
+                    # process) — purge any stale registration too, so cluster
+                    # DNS answers "no endpoints" instead of routing probes to
+                    # the dead (or worse, OS-reused) previous port
+                    with self._lock:
+                        entry = self._servers.get(key)
+                        if entry is not None and entry[0] == pod.metadata.uid:
+                            self._servers.pop(key)
 
         if pod.status.phase == "Running" and pod.is_ready():
             return None
+        # carry restart counts across status rewrites (crash-restart
+        # injection bumps them; a Ready transition must not zero them)
+        prior_restarts = {
+            s.name: s.restart_count for s in pod.status.container_statuses
+        }
         pod.status.phase = "Running"
         pod.status.pod_ip = pod.status.pod_ip or f"10.1.{next(_ip_seq) % 250}.{next(_ip_seq) % 250}"
         pod.status.container_statuses = [
             ContainerStatus(
                 name=c.name,
                 ready=True,
+                restart_count=prior_restarts.get(c.name, 0),
                 state=ContainerState(running={"startedAt": now_rfc3339()}),
                 image=c.image,
             )
@@ -193,6 +222,44 @@ class Kubelet:
         ]
         self._update_status(pod)
         return None
+
+    def _crash(self, pod: Pod, key: str) -> None:
+        """Injected container crash-restart: the in-pod server dies (its
+        close() is permanent — a fresh incarnation serves the restarted
+        container), the container goes not-ready with CrashLoopBackOff and
+        restartCount+1, and the startup clock resets so recovery replays the
+        normal bring-up path."""
+        self._drop_state(key)
+        already_crashed = (
+            pod.status.container_statuses
+            and not pod.status.container_statuses[0].ready
+            and pod.status.container_statuses[0].state
+            and pod.status.container_statuses[0].state.waiting
+            and pod.status.container_statuses[0].state.waiting.get("reason")
+            == "CrashLoopBackOff"
+        )
+        prior = {s.name: s for s in pod.status.container_statuses}
+        pod.status.phase = "Running"
+        pod.status.container_statuses = [
+            ContainerStatus(
+                name=c.name,
+                ready=False,
+                restart_count=(
+                    prior[c.name].restart_count if c.name in prior else 0
+                ) + (0 if already_crashed else 1),
+                state=ContainerState(
+                    waiting={"reason": "CrashLoopBackOff",
+                             "message": "injected container crash"}
+                ),
+                image=c.image,
+            )
+            for c in pod.spec.containers
+        ]
+        pod.status.conditions = [
+            Condition(type="PodScheduled", status="True"),
+            Condition(type="Ready", status="False", reason="CrashLoopBackOff"),
+        ]
+        self._update_status(pod)
 
     def _update_status(self, pod: Pod) -> None:
         try:

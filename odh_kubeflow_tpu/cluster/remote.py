@@ -47,6 +47,7 @@ from ..apimachinery import (
     NotFoundError,
     RESTMapper,
     Scheme,
+    TooManyRequestsError,
     UnauthorizedError,
     default_scheme,
 )
@@ -64,15 +65,23 @@ _ERROR_BY_REASON = {
     "Gone": GoneError,
     "AdmissionDenied": AdmissionDeniedError,
     "Unauthorized": UnauthorizedError,
+    "TooManyRequests": TooManyRequestsError,
 }
 
 
 def _error_from_response(code: int, raw: bytes) -> ApiError:
     reason, message = "", ""
+    retry_after: Optional[float] = None
     try:
         body = json.loads(raw)
         reason = body.get("reason", "")
         message = body.get("message", "")
+        details = body.get("details") or {}
+        if isinstance(details, dict) and details.get("retryAfterSeconds") is not None:
+            try:
+                retry_after = float(details["retryAfterSeconds"])
+            except (TypeError, ValueError):
+                retry_after = None
     except ValueError:
         message = raw.decode(errors="replace")[:500]
     cls = _ERROR_BY_REASON.get(reason)
@@ -84,7 +93,12 @@ def _error_from_response(code: int, raw: bytes) -> ApiError:
             401: UnauthorizedError,
             403: ForbiddenError,
             422: InvalidError,
+            429: TooManyRequestsError,
         }.get(code, ApiError)
+    if cls is TooManyRequestsError:
+        return TooManyRequestsError(
+            message or f"HTTP {code}", retry_after=retry_after or 1.0
+        )
     return cls(message or f"HTTP {code}")
 
 
@@ -337,6 +351,9 @@ class RemoteWatch:
                     log.debug("watch stream error (%s/%s): %r", self._kind, self._namespace, e)
             if self._stopped.is_set():
                 return
+            from ..runtime.metrics import watch_restarts_total
+
+            watch_restarts_total.inc(kind=self._kind)
             time.sleep(backoff)
             backoff = min(backoff * 2, 2.0)
 
@@ -399,6 +416,9 @@ class RemoteWatch:
         """410 recovery: replace state via a fresh list, synthesizing the diff
         (DELETED for vanished keys; ADDED/MODIFIED pass through as ADDED —
         informer caches upsert either way, level-triggered handlers re-run)."""
+        from ..runtime.metrics import relists_total
+
+        relists_total.inc(kind=self._kind)
         items, rv = self._store.list_raw_with_rv(
             self._api_version, self._kind, namespace=self._namespace
         )
@@ -644,17 +664,37 @@ class RemoteStore:
             )
         return pool
 
+    # server-side 429 handling: bounded retries honoring the Status body's
+    # retryAfterSeconds (capped — a hostile Retry-After must not park a
+    # reconcile worker), then surface TooManyRequestsError to the caller.
+    # Client._call sees the flag and does NOT add its own retry layer.
+    handles_throttle_retries = True
+    MAX_THROTTLE_RETRIES = 4
+    MAX_RETRY_AFTER_S = 2.0
+
     def _request(self, path: str, method: str = "GET",
                  body: Optional[Dict[str, Any]] = None,
                  content_type: str = "application/json") -> Dict[str, Any]:
         payload = json.dumps(body).encode() if body is not None else None
-        if self.throttle is not None:
-            self.throttle.acquire()
-        headers = self._headers(content_type if payload else None)
-        status, data = self._pool().request(method, path, payload, headers)
-        if status >= 400:
-            raise _error_from_response(status, data)
-        return json.loads(data) if data else {}
+        for attempt in range(self.MAX_THROTTLE_RETRIES + 1):
+            if self.throttle is not None:
+                self.throttle.acquire()
+            headers = self._headers(content_type if payload else None)
+            status, data = self._pool().request(method, path, payload, headers)
+            if status == 429 and attempt < self.MAX_THROTTLE_RETRIES:
+                err = _error_from_response(status, data)
+                from ..runtime.metrics import client_retries_total
+
+                client_retries_total.inc(cause="throttle")
+                time.sleep(
+                    min(max(getattr(err, "retry_after", 1.0), 0.0),
+                        self.MAX_RETRY_AFTER_S)
+                )
+                continue
+            if status >= 400:
+                raise _error_from_response(status, data)
+            return json.loads(data) if data else {}
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def _mapping(self, api_version: str, kind: str):
         return self.mapper.mapping_for(api_version, kind)
@@ -742,4 +782,7 @@ class RemoteStore:
         namespace: Optional[str] = None,
         send_initial: bool = True,
     ) -> RemoteWatch:
+        # no since_rv parameter on purpose: RemoteWatch is a full reflector
+        # (reconnect-from-last-RV and relist-on-410 live inside it), so the
+        # informer's resume path detects the absence and relist+diffs instead
         return RemoteWatch(self, api_version, kind, namespace, send_initial)

@@ -21,6 +21,7 @@ from ..tpu import (
     plan_slice,
 )
 from .client import Client
+from .faults import FaultInjector
 from .kubelet import Behavior, Kubelet, PodDecision
 from .scheduler import Scheduler
 from .statefulset import StatefulSetController
@@ -28,8 +29,11 @@ from .store import Store
 
 
 class SimCluster:
-    def __init__(self) -> None:
-        self.store = Store()
+    def __init__(self, faults: Optional[FaultInjector] = None) -> None:
+        # every cluster carries an injector (inert until rules are added):
+        # tests script faults without rebuilding the fixture
+        self.faults = faults or FaultInjector()
+        self.store = Store(faults=self.faults)
         self.client = Client(self.store)
         # system controllers are the CLUSTER side (kube-controller-manager /
         # kubelet analogs): they read authoritative store state, not a cache
@@ -134,6 +138,9 @@ class SimCluster:
         import urllib.request
 
         u = urlparse(url)
+        # probe-agent network partition: injected at the transport, so the
+        # agent itself stays healthy and heals the instant the rule lifts
+        self.faults.check("probe.http", host=u.hostname or "", url=url)
         target = self.resolve(u.hostname or "")
         if target is None:
             raise ConnectionError(f"no endpoints for {u.hostname}")

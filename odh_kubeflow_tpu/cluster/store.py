@@ -46,6 +46,8 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 BOOKMARK = "BOOKMARK"  # progress marker: current RV, no object payload
+DROPPED = "DROPPED"  # stream severed (fault injection / server restart):
+# consumers must treat the watch as dead and re-establish from their last RV
 
 # kinds whose GVK groups several served versions onto one storage key
 _STORAGE_KEY_OVERRIDES: Dict[Tuple[str, str], Tuple[str, str]] = {}
@@ -115,7 +117,7 @@ class Watch:
     def _admit(self, ev: Optional[WatchEvent]) -> bool:
         if ev is None or self._namespace is None:
             return True
-        if ev.type == BOOKMARK:  # progress markers are namespace-less
+        if ev.type in (BOOKMARK, DROPPED):  # stream-level, namespace-less
             return True
         return ev.object.get("metadata", {}).get("namespace", "") == self._namespace
 
@@ -288,8 +290,14 @@ class Store:
         scheme: Scheme = default_scheme,
         backend: str = "auto",
         watch_history_limit: int = 4096,
+        faults: Optional[Any] = None,
     ):
         self.scheme = scheme
+        # fault injection seam (cluster/faults.py FaultInjector); None in
+        # production — every hook site is a single attribute check
+        self.faults = faults
+        if faults is not None:
+            faults.bind_store(self)
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
         self._last_rv = 0
@@ -404,6 +412,8 @@ class Store:
         av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
         if not av or not kind:
             raise InvalidError("object missing apiVersion/kind")
+        if self.faults is not None:
+            self.faults.check("store.write", kind=kind, obj=obj, verb="create")
         with self._lock:
             obj = self._run_admission(AdmissionRequest(operation="CREATE", object=obj))
             meta = obj.setdefault("metadata", {})
@@ -429,6 +439,8 @@ class Store:
             return json.loads(raw)
 
     def get_raw(self, api_version: str, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        if self.faults is not None:
+            self.faults.check("store.read", kind=kind, name=name, verb="get")
         with self._lock:
             bucket = self._bucket(api_version, kind)
             key = self._obj_key(namespace, name)
@@ -443,6 +455,8 @@ class Store:
         namespace: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
     ) -> List[Dict[str, Any]]:
+        if self.faults is not None:
+            self.faults.check("store.read", kind=kind, verb="list")
         with self._lock:
             bucket = self._bucket(api_version, kind)
             if isinstance(bucket, _NativeBucket):
@@ -481,6 +495,10 @@ class Store:
         av, kind = obj.get("apiVersion", ""), obj.get("kind", "")
         meta = obj.get("metadata", {})
         ns, name = meta.get("namespace", ""), meta.get("name", "")
+        if self.faults is not None:
+            self.faults.check(
+                "store.write", kind=kind, obj=obj, name=name, verb="update"
+            )
         with self._lock:
             bucket = self._bucket(av, kind)
             key = self._obj_key(ns, name)
@@ -556,6 +574,8 @@ class Store:
             return self.update_raw(patched, subresource=subresource)
 
     def delete_raw(self, api_version: str, kind: str, namespace: str, name: str) -> None:
+        if self.faults is not None:
+            self.faults.check("store.write", kind=kind, name=name, verb="delete")
         with self._lock:
             bucket = self._bucket(api_version, kind)
             key = self._obj_key(namespace, name)
@@ -630,6 +650,10 @@ class Store:
         raises GoneError when the window has been trimmed past it."""
         q: "queue.Queue[Optional[WatchEvent]]" = queue.Queue()
         skey = self._storage_key(api_version, kind)
+        if self.faults is not None and since_rv is not None:
+            # injected 410: the resume window is "trimmed" regardless of the
+            # real history depth — forces the client's relist path
+            self.faults.check("store.watch_resume", kind=kind, rv=since_rv)
         with self._lock:
             pending: List[WatchEvent] = []
             if since_rv is not None:
@@ -675,3 +699,24 @@ class Store:
             w = Watch(q, cancel, namespace=namespace, bookmark=bookmark)
             w.pending = pending
         return w
+
+    def sever_watches(
+        self, api_version: Optional[str] = None, kind: Optional[str] = None
+    ) -> int:
+        """Fault injection: sever live watch streams as a dropped connection
+        would — each subscriber queue receives a DROPPED event and is
+        unsubscribed, so no further events arrive on it. Consumers (the
+        informer reflector loop, the HTTP watch handler) must re-establish
+        from their last seen resourceVersion. Returns queues severed."""
+        with self._lock:
+            severed = 0
+            for skey, queues in list(self._watchers.items()):
+                if api_version is not None and skey[0] != api_version:
+                    continue
+                if kind is not None and skey[1] != kind:
+                    continue
+                for q in queues:
+                    q.put(WatchEvent(DROPPED, {}))
+                    severed += 1
+                self._watchers[skey] = []
+            return severed

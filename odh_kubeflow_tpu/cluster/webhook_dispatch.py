@@ -173,11 +173,20 @@ class WebhookDispatcher:
             },
         }
         try:
+            faults = getattr(self.store, "faults", None)
+            if faults is not None:
+                # injected callout failure (timeout / refused connection)
+                # BEFORE the POST: the webhook never sees the review, exactly
+                # like a network-partitioned webhook service
+                faults.check("webhook.call", name=name, url=url)
             ctx = self._ssl_context(client_config.get("caBundle", ""))
             body = self._post_pooled(url, json.dumps(review).encode(), ctx, timeout)
         except AdmissionDeniedError:
             raise
         except Exception as e:
+            from ..runtime.metrics import webhook_dispatch_failures_total
+
+            webhook_dispatch_failures_total.inc(policy=failure_policy)
             if failure_policy == "Ignore":
                 log.warning("webhook %s unreachable (failurePolicy=Ignore): %r", name, e)
                 return obj

@@ -1,0 +1,37 @@
+"""API-drift shims for the accelerator stack.
+
+The model/parallel code is written against the current public surface
+(`jax.shard_map` with `check_vma=`); older pinned environments (<= 0.4.x)
+only ship `jax.experimental.shard_map.shard_map` with the pre-rename
+`check_rep=` keyword. One shim here, consulted by every call site, keeps the
+code on the modern spelling without a hard floor on the jax pin.
+"""
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        # pre-0.5 idiom: psum of the python scalar 1 over a named axis is
+        # constant-folded to the (static) axis size
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kwargs):
+        # pre-0.5 spelling: check_vma was check_rep (same semantics for the
+        # False we pass: skip the replication-consistency check)
+        return _experimental_shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+            **kwargs,
+        )

@@ -33,6 +33,11 @@ class Config:
     # TPU-native culling signal: require BOTH Jupyter-idle and TPU-idle
     tpu_idle_threshold: float = 0.05  # duty cycle below which the slice is idle
     probe_port: int = 8889
+    # probe circuit breaker (runtime/breaker.py): after `threshold`
+    # consecutive jupyter-probe failures for one notebook, skip probing it
+    # for a growing cooldown instead of paying connect timeouts every cycle
+    probe_breaker_threshold: int = 3
+    probe_breaker_cooldown_s: float = 30.0
     # device-visibility readiness gate (controllers/probe_status.py): poll
     # cadence for /tpu/readiness until the mesh gate is green
     readiness_probe_period_s: float = 10.0
@@ -72,6 +77,14 @@ class Config:
         c.inject_cluster_proxy_env = _env_bool(
             "INJECT_CLUSTER_PROXY_ENV", c.inject_cluster_proxy_env
         )
+        if os.environ.get("PROBE_BREAKER_THRESHOLD"):
+            c.probe_breaker_threshold = max(
+                1, int(os.environ["PROBE_BREAKER_THRESHOLD"])
+            )
+        if os.environ.get("PROBE_BREAKER_COOLDOWN_S"):
+            c.probe_breaker_cooldown_s = float(
+                os.environ["PROBE_BREAKER_COOLDOWN_S"]
+            )
         if os.environ.get("READINESS_PROBE_PERIOD_S"):
             c.readiness_probe_period_s = float(os.environ["READINESS_PROBE_PERIOD_S"])
         if os.environ.get("MAX_CONCURRENT_RECONCILES"):

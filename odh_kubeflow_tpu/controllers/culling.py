@@ -26,6 +26,7 @@ from ..api.core import Pod
 from ..api.notebook import Notebook
 from ..apimachinery import NotFoundError, now_rfc3339, parse_time, rfc3339
 from ..cluster.client import retry_on_conflict
+from ..runtime.breaker import CircuitBreaker
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import plan_slice
@@ -63,6 +64,13 @@ class CullingReconciler:
         self.config = config or Config()
         self.http_get = http_get or _default_http_get
         self.metrics = metrics or NotebookMetrics(manager.metrics)
+        # per-notebook probe circuit breaker: repeated probe failures open
+        # it, and the reconcile then skips + requeues with backoff instead
+        # of paying a connect timeout against a dead agent every cycle
+        self.breaker = CircuitBreaker(
+            failure_threshold=self.config.probe_breaker_threshold,
+            cooldown_s=self.config.probe_breaker_cooldown_s,
+        )
 
     def setup(self) -> None:
         """Gated on ENABLE_CULLING exactly like the reference's main()
@@ -168,6 +176,7 @@ class CullingReconciler:
         try:
             nb = self.api_reader.get(Notebook, req.namespace, req.name)
         except NotFoundError:
+            self.breaker.forget(req.key)  # no monotonic growth across churn
             return None
         if nb.metadata.deletion_timestamp:
             return None
@@ -230,15 +239,30 @@ class CullingReconciler:
             except ValueError:
                 pass
 
+        # probe circuit breaker: a notebook whose agent keeps failing is
+        # skipped (requeue with the breaker's cooldown) instead of hammered —
+        # one dead agent must not absorb this controller's worker time
+        if not self.breaker.allow(req.key):
+            return Result(
+                requeue_after=max(0.05, min(self.breaker.retry_after(req.key), period_s))
+            )
+
         # probe (reference :165-167; TPU extension)
         try:
             jupyter_busy, jupyter_last = self.probe_jupyter(nb)
         except Exception as e:
             log.warning("culling: jupyter probe failed for %s: %s", req.key, e)
+            if self.breaker.record_failure(req.key):
+                log.warning(
+                    "culling: probe breaker OPEN for %s (%d consecutive failures)",
+                    req.key,
+                    self.config.probe_breaker_threshold,
+                )
             self._patch_annotations(
                 nb, {C.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION: now_rfc3339()}
             )
             return Result(requeue_after=period_s)
+        self.breaker.record_success(req.key)
         tpu = self.probe_tpu(nb)
 
         busy = jupyter_busy or (tpu is not None and tpu[0])

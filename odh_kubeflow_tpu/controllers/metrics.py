@@ -43,6 +43,11 @@ class NotebookMetrics:
         self.tpu_chips_bound = registry.gauge(
             "notebook_tpu_chips_bound", "TPU chips currently bound to notebooks"
         )
+        self.probe_unreachable_total = registry.counter(
+            "notebook_probe_unreachable_total",
+            "Per-host readiness probes that found the agent unreachable "
+            "(partitions, crashed probe processes, bring-up races)",
+        )
         self.slice_ready_seconds = registry.histogram(
             "notebook_slice_ready_seconds",
             "Notebook CR to slice-ready latency (the north-star metric)",

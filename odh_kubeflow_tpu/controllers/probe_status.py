@@ -100,7 +100,11 @@ class ProbeStatusController:
         if not urls:
             return []
         with ThreadPoolExecutor(max_workers=min(16, len(urls))) as pool:
-            return list(pool.map(probe, urls))
+            reports = list(pool.map(probe, urls))
+        unreachable = sum(1 for r in reports if r is None)
+        if unreachable:
+            self.metrics.probe_unreachable_total.inc(unreachable)
+        return reports
 
     # ---------- reconcile ----------
 

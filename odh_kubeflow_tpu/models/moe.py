@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import compat
+
 
 @dataclass(frozen=True)
 class MoEConfig:
@@ -382,7 +384,7 @@ def _moe_ffn_ep_indexed(
             aux = jax.lax.pmean(aux, a)
         return out, aux
 
-    return jax.shard_map(
+    return compat.shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, x_spec),

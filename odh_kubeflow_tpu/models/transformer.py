@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import compat
 from ..ops import apply_rope, flash_attention, mha_reference, ring_attention, rms_norm
 from ..parallel.mesh import logical_to_spec
 from .moe import MOE_AXES, MoEConfig, init_moe_params, moe_ffn
@@ -232,7 +233,7 @@ def _attention(q, k, v, cfg: TransformerConfig, mesh=None):
             ring = partial(ring_attention, axis_name=cfg.seq_axis, causal=True)
         q_spec = logical_to_spec(("batch", "seq", "heads", "head_dim"), mesh)
         kv_spec = logical_to_spec(("batch", "seq", "kv_heads", "head_dim"), mesh)
-        fn = jax.shard_map(
+        fn = compat.shard_map(
             ring,
             mesh=mesh,
             in_specs=(q_spec, kv_spec, kv_spec),
@@ -624,7 +625,7 @@ def pp_forward(
             if cfg.seq_layout == "zigzag":
                 # shard r stores natural chunks r and 2S-1-r back to back
                 # (ops/ring_attention.zigzag_permutation)
-                sp_n = lax.axis_size(cfg.seq_axis)
+                sp_n = compat.axis_size(cfg.seq_axis)
                 c = local_s // 2
                 ar = jnp.arange(c, dtype=jnp.int32)
                 pos = jnp.concatenate(

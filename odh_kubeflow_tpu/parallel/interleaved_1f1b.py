@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import compat
 from .pipeline import finish_head_grad, finish_stage_grad, wrap_stage_fn
 
 @dataclass
@@ -567,7 +568,7 @@ def pipeline_value_and_grad_interleaved_1f1b(
     x_spec = P(data_axes if data_axes else None)
     head_rep_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
     out_specs = (P(), param_specs, head_rep_specs, x_spec)
-    return jax.shard_map(
+    return compat.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(param_specs, head_rep_specs, x_spec, x_spec),

@@ -46,6 +46,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import compat
+
 
 def pipeline_apply(
     stage_fn: Callable[[Any, jnp.ndarray], Any],
@@ -110,6 +112,22 @@ def pipeline_apply(
         raise NotImplementedError(
             "sp inside pipeline stages is composed with the GPipe schedule "
             "only; the interleaved engine does not thread sequence shards"
+        )
+    if with_aux and seq_axis and sizes.get(seq_axis, 1) > 1:
+        # Documented approximation, surfaced loudly: under sequence sharding
+        # the router aux is the mean of PER-SHARD statistics, not the
+        # full-sequence aux (MoE's Switch aux is quadratic in per-shard token
+        # stats — see the pmean note below and models/moe.py routing notes).
+        # Dense stacks (aux == 0) are exact and parity-tested; MoE x pp x sp
+        # users must opt into the per-shard semantics knowingly.
+        import warnings
+
+        warnings.warn(
+            "pipeline_apply(with_aux=True) under seq_axis sums per-shard "
+            "router aux values (the per-shard routing approximation), not "
+            "the full-sequence statistic; exact only for dense stacks "
+            "(aux == 0). See parallel/pipeline.py aux notes.",
+            stacklevel=2,
         )
     if n_chunks > 1:
         if n_micro % n_stages:
@@ -179,7 +197,7 @@ def pipeline_apply(
     )
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    return jax.shard_map(
+    return compat.shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(param_specs, x_spec),
@@ -263,7 +281,7 @@ def _pipeline_apply_interleaved(
     x_spec = P(data_axes if data_axes else None)
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-    return jax.shard_map(
+    return compat.shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(param_specs, x_spec),
@@ -586,7 +604,7 @@ def pipeline_value_and_grad_1f1b(
     head_rep_specs = jax.tree_util.tree_map(lambda _: P(), head_params)
     # stage grads come back in the (S, ...) storage layout and sharding
     out_specs = (P(), param_specs, head_rep_specs, x_spec)
-    loss, d_stage, d_head, dx = jax.shard_map(
+    loss, d_stage, d_head, dx = compat.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(param_specs, head_rep_specs, x_spec, x_spec),

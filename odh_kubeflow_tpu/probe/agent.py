@@ -409,17 +409,21 @@ class NotebookAgent:
         # Race-safe and idempotent against a concurrent/earlier close():
         # - a live agent returns its existing endpoint (no duplicate servers
         #   when the kubelet sim retries a reconcile),
-        # - a CLOSED agent stays closed — it returns the last (now dead)
-        #   port so probes get connection-refused, mirroring a crashed
-        #   in-pod probe process. The old code re-read self._server after
-        #   releasing no lock: close() between the assignment and the
-        #   server_port read crashed the kubelet reconcile (AttributeError),
-        #   and the backoff RETRY then re-opened the closed probe —
-        #   observed as test_unreachable_probe_keeps_gate_closed reporting
+        # - a CLOSED agent stays closed — it returns port 0, the explicit
+        #   "no listener" sentinel (the kubelet sim treats it as
+        #   unreachable). Returning the stale _last_port here routed probes
+        #   to whatever NOW owns that ephemeral port: the OS reuses freed
+        #   ports, so a probe could reach an UNRELATED server and read a
+        #   healthy response from the wrong notebook. The old code also
+        #   re-read self._server after releasing no lock: close() between
+        #   the assignment and the server_port read crashed the kubelet
+        #   reconcile (AttributeError), and the backoff RETRY then re-opened
+        #   the closed probe — observed as
+        #   test_unreachable_probe_keeps_gate_closed reporting
         #   mesh_ready=True under CPU starvation.
         with self._serve_lock:
             if self._closed:
-                return (host, self._last_port or 1, self.close)
+                return (host, 0, self.close)
             if self._server is not None:
                 return (host, self._server.server_port, self.close)
             server = ThreadingHTTPServer((host, port), Handler)
@@ -455,10 +459,11 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
     """Kubelet-sim pod behavior running one NotebookAgent per notebook pod.
 
     The shared fixture for tests, bench.py and the loadtest: caches one agent
-    per (pod name, uid) — the kubelet calls the behavior on every reconcile,
-    so the served state and the caller's handle must not diverge — and
-    aliases it under the bare pod name for scripting (`agents["nb-0"]`).
-    Chips default to the pod's `google.com/tpu` request.
+    per (pod name, uid, container restarts) — the kubelet calls the behavior
+    on every reconcile, so the served state and the caller's handle must not
+    diverge; a crash-restarted container gets a fresh agent — and aliases it
+    under the bare pod name for scripting (`agents["nb-0"]`, always the
+    latest incarnation). Chips default to the pod's `google.com/tpu` request.
 
     visible_chips degrades REPORTED visibility from agent birth (expected
     stays at the pod's request) — int for all pods, or {pod_name: chips} for
@@ -470,7 +475,12 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
     def behavior(pod):
         if not pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
             return None
-        key = (pod.metadata.name, pod.metadata.uid)
+        # keyed per container incarnation: a crash-restarted container (same
+        # pod uid, restartCount bumped by the kubelet's crash injection) gets
+        # a FRESH agent — its predecessor's close() is permanent (port-0
+        # sentinel), like a died-and-respawned in-pod probe process
+        restarts = sum(s.restart_count for s in pod.status.container_statuses)
+        key = (pod.metadata.name, pod.metadata.uid, restarts)
         if key not in agents:
             n_chips = chips
             if n_chips is None:

@@ -1,0 +1,106 @@
+"""Per-key circuit breaker for outbound probes.
+
+The culling controller's probe loop has a pathological failure mode without
+this: a dead/partitioned probe agent makes every reconcile pay full HTTP
+connect timeouts, and with one worker pool shared across all notebooks, one
+dark host starves every other slice's idleness checks. The breaker converts
+"keep hammering a dead agent" into "skip + requeue with backoff":
+
+- CLOSED: probes flow; `failure_threshold` consecutive failures OPEN it.
+- OPEN: `allow()` is False for a cooldown that doubles per consecutive trip
+  (capped), so a long-dead agent costs one skipped probe per cooldown, not
+  one timeout per reconcile.
+- HALF-OPEN: after the cooldown one trial probe is let through; success
+  closes the breaker and resets the cooldown, failure re-opens it.
+
+Thread-safe; time injected for tests via the `clock` callable.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .metrics import breaker_trips_total
+
+
+class _Entry:
+    __slots__ = ("failures", "opened_at", "cooldown", "half_open_probe")
+
+    def __init__(self) -> None:
+        self.failures = 0
+        self.opened_at: Optional[float] = None
+        self.cooldown = 0.0
+        self.half_open_probe = False
+
+
+class CircuitBreaker:
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        cooldown_s: float = 30.0,
+        max_cooldown_s: float = 300.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.max_cooldown_s = max_cooldown_s
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Entry] = {}
+        self.trips = 0  # observability mirror of breaker_trips_total
+
+    def allow(self, key: str) -> bool:
+        """May a probe for `key` proceed right now? An OPEN breaker admits
+        exactly one trial per elapsed cooldown (half-open)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.opened_at is None:
+                return True
+            if self.clock() - e.opened_at < e.cooldown:
+                return False
+            if e.half_open_probe:
+                return False  # a trial is already in flight
+            e.half_open_probe = True
+            return True
+
+    def retry_after(self, key: str) -> float:
+        """Seconds until the breaker would admit a trial (0 when closed) —
+        the requeue delay for a skipped reconcile."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.opened_at is None:
+                return 0.0
+            return max(0.0, e.cooldown - (self.clock() - e.opened_at))
+
+    def record_success(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def record_failure(self, key: str) -> bool:
+        """Returns True when this failure OPENED (or re-opened) the breaker."""
+        with self._lock:
+            e = self._entries.setdefault(key, _Entry())
+            e.failures += 1
+            if e.opened_at is not None:
+                # half-open trial failed: re-open with a doubled cooldown
+                e.opened_at = self.clock()
+                e.cooldown = min(e.cooldown * 2, self.max_cooldown_s)
+                e.half_open_probe = False
+                return False
+            if e.failures >= self.failure_threshold:
+                e.opened_at = self.clock()
+                e.cooldown = self.cooldown_s
+                e.half_open_probe = False
+                self.trips += 1
+                breaker_trips_total.inc()
+                return True
+            return False
+
+    def is_open(self, key: str) -> bool:
+        with self._lock:
+            e = self._entries.get(key)
+            return bool(e and e.opened_at is not None)
+
+    def forget(self, key: str) -> None:
+        self.record_success(key)

@@ -1,20 +1,40 @@
 """Shared informers: one watch per kind, an in-memory cache, and fan-out to
 event handlers. This is the informer/cache layer controller-runtime gives the
 reference for free; reads in our controllers go through the cache just like
-the reference's mgr.GetClient() reads (with the same staleness caveats)."""
+the reference's mgr.GetClient() reads (with the same staleness caveats).
+
+The watch loop is a full reflector (client-go Reflector semantics): a severed
+stream is re-established from the last seen resourceVersion with jittered
+exponential backoff, and a 410 Expired resume degrades to relist+diff — the
+cache is compared against the fresh list so handlers observe synthetic
+MODIFIED/ADDED upserts and DELETED for keys that vanished while the watch was
+down. `synced` stays set across relists: the cache keeps serving (stale)
+reads during recovery, exactly as client-go does."""
 from __future__ import annotations
 
+import inspect
+import logging
 import threading
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..apimachinery import Scheme, default_scheme
-from ..cluster.store import ADDED, DELETED, MODIFIED, Store, WatchEvent
+from ..apimachinery import GoneError, Scheme, default_scheme
+from ..cluster.store import ADDED, DELETED, DROPPED, MODIFIED, Store, WatchEvent
+from .metrics import relists_total, watch_restarts_total
+
+log = logging.getLogger(__name__)
 
 # handler(event_type, obj_dict, old_obj_dict_or_None)
 EventHandler = Callable[[str, dict, Optional[dict]], None]
 
 
 class Informer:
+    # reconnect backoff: base * 2^n, jittered to [0.5, 1.5)x, capped — fast
+    # enough that a test-scale drop heals in tens of ms, slow enough that a
+    # down apiserver is not hammered by every informer in lockstep
+    BACKOFF_BASE = 0.05
+    BACKOFF_MAX = 2.0
+
     def __init__(self, store: Store, api_version: str, kind: str):
         self.store = store
         self.api_version = api_version
@@ -26,6 +46,18 @@ class Informer:
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self.synced = threading.Event()
+        self._rv: str = ""  # last seen resourceVersion (the resume point)
+        # deterministic per-kind jitter stream (no shared global RNG state)
+        import random
+
+        self._rng = random.Random(zlib.crc32(f"{api_version}/{kind}".encode()))
+        # resume capability: the in-proc Store replays history after an RV;
+        # RemoteStore's watch is itself a reflector (resume handled inside),
+        # so reconnects there fall back to the relist path
+        try:
+            self._can_resume = "since_rv" in inspect.signature(store.watch).parameters
+        except (TypeError, ValueError):  # builtins / exotic callables
+            self._can_resume = False
 
     def add_handler(self, handler: EventHandler) -> None:
         with self._lock:
@@ -37,7 +69,16 @@ class Informer:
     def start(self) -> None:
         if self._thread is not None:
             return
-        self._watch = self.store.watch(self.api_version, self.kind)
+        try:
+            self._watch = self.store.watch(self.api_version, self.kind)
+        except Exception as e:
+            # a throttled/unreachable apiserver at startup must not kill the
+            # manager — the reflector loop establishes the watch with backoff
+            log.warning(
+                "informer %s: initial watch failed (%r); retrying with backoff",
+                self.kind, e,
+            )
+            self._watch = None
         self._thread = threading.Thread(
             target=self._run, name=f"informer-{self.kind}", daemon=True
         )
@@ -48,19 +89,115 @@ class Informer:
         ns = m.get("namespace", "")
         return f"{ns}/{m.get('name', '')}" if ns else m.get("name", "")
 
+    # -- reflector loop --
+
     def _run(self) -> None:
-        assert self._watch is not None
+        w = self._watch
+        if w is None:  # initial establishment failed: retry with backoff
+            w = self._reestablish()
+            if w is None:
+                return
+            self._watch = w
         # drain the initial synthetic ADDs, then mark synced
-        while self._watch.pending:
-            self._dispatch(self._watch.pending.pop(0))
+        while w.pending:
+            self._dispatch(w.pending.pop(0))
         self.synced.set()
-        for ev in self._watch:
+        while not self._stopped.is_set():
+            ev = w.get()
             if self._stopped.is_set():
                 return
+            if ev is None or ev.type == DROPPED:
+                # stream severed (connection drop / server restart): the
+                # informer must not die with it — re-establish from _rv
+                w = self._reestablish()
+                if w is None:
+                    return
+                self._watch = w
+                while w.pending:
+                    if self._stopped.is_set():
+                        return
+                    self._dispatch(w.pending.pop(0))
+                continue
             self._dispatch(ev)
+
+    def _reestablish(self):
+        """Reconnect the watch with jittered exponential backoff; a 410 on
+        resume (or no resume point at all) degrades to relist+diff."""
+        watch_restarts_total.inc(kind=self.kind)
+        backoff = self.BACKOFF_BASE
+        last_err = ""
+        while not self._stopped.is_set():
+            delay = backoff * (0.5 + self._rng.random())
+            if self._stopped.wait(delay):
+                return None
+            try:
+                if self._rv and self._can_resume:
+                    return self.store.watch(
+                        self.api_version, self.kind,
+                        send_initial=False, since_rv=self._rv,
+                    )
+                return self._relist_watch()
+            except GoneError:
+                try:
+                    return self._relist_watch()
+                except Exception as e:
+                    err = e  # relist itself failed (throttle/outage): back off
+            except Exception as e:
+                err = e
+            # a transient blip heals silently in one backoff step, but a
+            # PERSISTENT failure (bad token, dead apiserver) must not spin
+            # invisibly forever — log each distinct error once
+            if repr(err) != last_err:
+                last_err = repr(err)
+                log.warning(
+                    "informer %s: watch re-establish failed (%r); "
+                    "retrying with backoff", self.kind, err,
+                )
+            backoff = min(backoff * 2, self.BACKOFF_MAX)
+        return None
+
+    def _relist_watch(self):
+        """Replace cache state via a fresh list: handlers see the DIFF —
+        DELETED for keys that vanished while the watch was down, ADDED for
+        new keys, MODIFIED upserts for survivors (level-triggered handlers
+        re-run; edge-triggered ones see a correct transition). Returns the
+        new watch, established from the list's collection RV so no event in
+        the gap is missed."""
+        if self._can_resume:
+            items, rv = self.store.list_raw_with_rv(self.api_version, self.kind)
+            w = self.store.watch(
+                self.api_version, self.kind, send_initial=False, since_rv=rv
+            )
+        else:
+            # RemoteStore: its watch reflector snapshots internally and
+            # streams from THAT snapshot's RV — using its pending events as
+            # the list means no separate LIST and, crucially, no window
+            # between our list and the watch's own where an event could be
+            # lost for good
+            w = self.store.watch(self.api_version, self.kind)
+            items = [ev.object for ev in w.pending]
+            w.pending = []
+            rv = ""
+        relists_total.inc(kind=self.kind)
+        fresh: Dict[str, dict] = {self._key(o): o for o in items}
+        with self._lock:
+            vanished: List[Tuple[str, dict]] = [
+                (k, obj) for k, obj in self._cache.items() if k not in fresh
+            ]
+            known = set(self._cache)
+        for _key, obj in vanished:
+            self._dispatch(WatchEvent(DELETED, obj))
+        for key, obj in fresh.items():
+            self._dispatch(WatchEvent(MODIFIED if key in known else ADDED, obj))
+        if rv:
+            self._rv = rv
+        return w
 
     def _dispatch(self, ev: WatchEvent) -> None:
         key = self._key(ev.object)
+        rv = ev.object.get("metadata", {}).get("resourceVersion")
+        if rv:
+            self._rv = rv
         with self._lock:
             old = self._cache.get(key)
             if ev.type == DELETED:

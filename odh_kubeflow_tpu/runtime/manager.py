@@ -181,7 +181,16 @@ class Manager:
         self._started = False
         self.elector: Optional[LeaderElector] = None
         if leader_election:
-            self.elector = LeaderElector(self.client, leader_election_id)
+            # the elector gets its OWN unfenced client: lease acquisition is
+            # the one write that must go through while we are NOT leader
+            self.elector = LeaderElector(Client(store, scheme), leader_election_id)
+            # fencing: once the lease lapses, every write through the
+            # manager's client is refused — a partitioned ex-leader's
+            # in-flight reconciles cannot mutate the cluster past its lease
+            # (the lease-loss path also stops the controllers; the fence
+            # closes the in-flight window)
+            elector = self.elector
+            self.client.write_fence = lambda: elector.is_leader.is_set()
 
     def builder(self, name: str) -> "Builder":
         # deferred: builder imports cluster.store, whose package init reaches

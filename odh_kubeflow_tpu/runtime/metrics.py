@@ -163,3 +163,36 @@ class Registry:
 
 
 global_registry = Registry()
+
+# ---- control-plane resilience counters (tests assert these move under
+# fault injection and stay flat on the fault-free path; registration is
+# idempotent, so importers share one series set) ----
+
+watch_restarts_total = global_registry.counter(
+    "informer_watch_restarts_total",
+    "Watch streams re-established after a drop, by kind",
+    labels=("kind",),
+)
+relists_total = global_registry.counter(
+    "informer_relists_total",
+    "Full relist+diff recoveries (410 Expired resume), by kind",
+    labels=("kind",),
+)
+client_retries_total = global_registry.counter(
+    "client_retries_total",
+    "Client-side request retries, by cause (429 throttle, ...)",
+    labels=("cause",),
+)
+webhook_dispatch_failures_total = global_registry.counter(
+    "webhook_dispatch_failures_total",
+    "Admission webhook callout failures, by the failurePolicy applied",
+    labels=("policy",),
+)
+breaker_trips_total = global_registry.counter(
+    "probe_breaker_trips_total",
+    "Probe circuit-breaker open transitions (repeated probe failures)",
+)
+fenced_writes_total = global_registry.counter(
+    "fenced_writes_total",
+    "Writes refused by leader-election fencing (lease not held)",
+)

@@ -24,3 +24,15 @@ except ImportError:  # pragma: no cover
     pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Tests are written against the modern `jax.shard_map` spelling; on an older
+# pinned jax the compat shim (check_vma -> check_rep) provides it.
+try:
+    import jax as _jax
+
+    if not hasattr(_jax, "shard_map"):
+        from odh_kubeflow_tpu import compat as _compat
+
+        _jax.shard_map = _compat.shard_map
+except ImportError:  # pragma: no cover
+    pass

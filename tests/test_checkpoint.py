@@ -6,6 +6,7 @@ slice restores the sharded train state and continues bit-exactly.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from odh_kubeflow_tpu.models import (
     TransformerConfig,
@@ -81,6 +82,7 @@ def test_max_to_keep_prunes(tmp_path):
     assert np.allclose(np.asarray(restored["x"]), np.arange(8.0))
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_sharded_state_save_restore(tmp_path):
     """Checkpoint/resume for the PIPELINE storage layout: stage-stacked
     params sharded pp x tp x fsdp (incl. the interleaved wqkv and ZeRO

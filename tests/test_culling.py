@@ -141,8 +141,20 @@ def test_unstop_restarts_cull_cycle(env):
     # the culler legitimately re-culls within its (1 s) budget, and the
     # replacement never starts. That is configured-correct behavior — a
     # real user clicks restart again — so the test retries the unstop a
-    # few times instead of requiring the first click to win the race.
+    # few times instead of requiring the first click to win the race. The
+    # re-clicks are BOUNDED: each one must correspond to a real re-cull
+    # race, so a persistently-lost unstop (a controller eating the patch)
+    # fails the test loudly instead of hiding inside the retry loop.
+    MAX_RECULL_CLICKS = 10
+    clicks = 0
+
     def unstop():
+        nonlocal clicks
+        clicks += 1
+        assert clicks <= MAX_RECULL_CLICKS, (
+            f"unstop re-clicked {clicks}x: the stop annotation keeps "
+            "returning — the unstop is being lost, not raced"
+        )
         cluster.client.patch(
             Notebook, "user", "cycle",
             {"metadata": {"annotations": {C.STOP_ANNOTATION: None}}},

@@ -58,6 +58,7 @@ def test_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_sharded_matches_single_device():
     """Same params/batch: loss on the fsdp=2,tp=2,sp=2 mesh (ring attention
     on) must match the unsharded loss — collectives change layout, not math."""
@@ -90,6 +91,7 @@ def test_param_specs_match_param_tree():
         assert len(s) <= p.ndim
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_graft_entry_contract():
     import __graft_entry__ as ge
 
@@ -349,6 +351,7 @@ def test_causal_ce_matches_log_softmax_reference():
     )
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_zigzag_seq_layout_loss_matches_natural():
     """cfg.seq_layout="zigzag" + make_zigzag_batch on an sp=2 mesh: the LM
     loss equals the natural-order loss on the full batch (the mean over

@@ -161,6 +161,7 @@ def test_top1_router_keeps_lm_gradient():
     assert float(jnp.sum(jnp.abs(grads["layers"]["router"]))) > 0
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_moe_composition():
     """MoE composes with pipeline parallelism: pp=2 x ep=2, expert weights
     ep-sharded inside the stages (manual-collective MoE), aux threaded
@@ -288,6 +289,7 @@ def test_dispatch_only_and_routing_stats():
     assert float(stats["drop_rate"]) == 0.0
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_moe_1f1b_parity():
     """VERDICT r4 #3: the 1F1B schedule threads the MoE aux channel — loss
     AND gradients match GPipe (autodiff through the aux-threaded pipeline)
@@ -355,6 +357,7 @@ def test_pp_moe_1f1b_parity():
         assert float(jnp.sum(jnp.abs(f_grads["layers"]["router"]))) > 0
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_moe_interleaved_1f1b_parity():
     """The full composition: Megatron interleaved 1F1B (pp=2 x v=2) with
     ep-sharded MoE experts inside the chunks and the aux channel threaded —

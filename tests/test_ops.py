@@ -265,6 +265,7 @@ def test_ring_attention_reference_grads(kv_heads):
         assert jnp.max(jnp.abs(a - b)) / scale < 1e-5, name
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 @pytest.mark.parametrize("sp,causal,kv_heads", [(2, True, 2), (2, True, 4),
                                                 (2, False, 4), (4, True, 1)])
 def test_ring_attention_kernel_path_interpret(sp, causal, kv_heads):
@@ -302,6 +303,7 @@ def test_ring_attention_kernel_path_interpret(sp, causal, kv_heads):
         assert jnp.max(jnp.abs(a - b)) / scale < 2e-2, name
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 @pytest.mark.parametrize("sp,kv_heads,kernel", [(2, 2, False), (4, 1, False),
                                                 (2, 4, True), (2, 2, True)])
 def test_zigzag_ring_attention_parity(sp, kv_heads, kernel):

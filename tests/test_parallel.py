@@ -149,6 +149,7 @@ def test_pipeline_gradients_match_sequential():
     assert np.allclose(np.asarray(g_pipe), np.asarray(g_seq), atol=1e-5)
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_transformer_train_step():
     """Flagship model trains under pp=2 with sharded stage params; loss
     matches the non-pipelined model on identical inputs."""
@@ -196,6 +197,7 @@ def test_pp_transformer_train_step():
     assert np.allclose(float(loss), float(ref_loss), atol=1e-4)
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_tp_manual_stage_parallelism():
     """VERDICT r4 #2: pp composes with tp — stage matmuls run manual
     Megatron-style tensor parallelism (wqkv/wi column-parallel, wo/wo_mlp
@@ -276,6 +278,7 @@ def test_pp_tp_manual_stage_parallelism():
         )
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_1f1b_matches_gpipe_and_sequential():
     """VERDICT r4 #8: the 1F1B schedule produces the same loss and gradients
     as GPipe (and the non-pipelined model) to float tolerance, across
@@ -393,6 +396,7 @@ def test_interleaved_pipeline_matches_sequential():
         )
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_interleaved_pp_transformer_parity():
     """Interleaved virtual stages on the flagship model: pp=2 x v=2 over 8
     layers, composed with manual tp + ZeRO stage storage — loss and
@@ -497,6 +501,7 @@ def test_interleaved_1f1b_schedule_invariants():
         build_schedule(4, 2, 6)
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_interleaved_1f1b_transformer_parity():
     """VERDICT r4 #4 — Megatron's interleaved 1F1B on the flagship model:
     pp=2 x v=2 over 8 layers with manual tp + ZeRO stage storage; loss and
@@ -568,6 +573,7 @@ def test_interleaved_1f1b_transformer_parity():
             )
 
 
+@pytest.mark.slow  # compile-heavy CPU-mesh parity (minutes): run via -m slow
 def test_pp_sp_ring_inside_stages():
     """Long-context x pipeline: GPipe stages run ring attention on sequence
     shards (pipeline_apply seq_axis + _attention's seq_axis_bound path,
@@ -663,3 +669,40 @@ def test_pp_sp_ring_inside_stages():
             np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4,
             err_msg=f"zigzag {jax.tree_util.keystr(pa)}",
         )
+
+
+def test_pipeline_aux_under_sp_warns_per_shard_approximation():
+    """MoE router-aux under seq_axis is a documented per-shard approximation
+    (parallel/pipeline.py aux notes): only dense pp x sp configs are
+    parity-tested, so configuring an aux-carrying pipeline with sequence
+    sharding must SAY SO — pipeline_apply emits a warning before tracing.
+    Dense (with_aux=False) and unsharded-seq aux paths stay silent."""
+    import warnings
+
+    from odh_kubeflow_tpu.parallel import MeshPlan, pipeline_apply, stack_stages
+
+    plan = MeshPlan(pp=2, sp=2)
+    mesh = plan.build(jax.devices()[:4])
+    d = 8
+    w = jax.random.normal(jax.random.PRNGKey(0), (2, d, d)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 4, d))  # (batch, seq, d)
+    stages = stack_stages(w, 2)
+
+    def stage_fn(stage_w, h):
+        return jnp.tanh(h @ stage_w[0]), jnp.float32(0.0)
+
+    def run(**kw):
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            try:
+                pipeline_apply(stage_fn, stages, x, mesh, n_micro=2,
+                               with_aux=True, **kw)
+            except Exception:
+                # the compute path may be unavailable in this environment
+                # (jax API drift); the contract under test is the warning,
+                # which fires before tracing
+                pass
+        return [w for w in rec if "per-shard" in str(w.message)]
+
+    assert run(seq_axis="sp"), "aux + sp must warn about the per-shard aux"
+    assert not run(), "aux without sequence sharding must stay silent"
