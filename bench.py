@@ -272,6 +272,11 @@ def bench_train_step():
     # 6*P per token (fwd+bwd) + attention term 12*L*d*s
     flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
     mfu = flops_per_token * tokens_per_s / V5E_PEAK_FLOPS
+    # publish to the shared telemetry registry (step-time histogram +
+    # tokens/s + MFU gauges land on any /metrics scrape of this process)
+    from odh_kubeflow_tpu.tpu import telemetry
+
+    telemetry.observe_train_step(step_s, tokens=batch * seq, mfu_est=mfu)
     return {
         "tokens_per_s": round(tokens_per_s),
         "step_ms": round(step_s * 1e3, 1),
@@ -534,6 +539,9 @@ def _decode_point(cfg, batch, prompt_len, max_new, short_new, max_seq):
         max_seq
     ) * cfg.kv_heads * cfg.head_dim
     hbm_util = bytes_per_step / (decode_s / (max_new - 1)) / V5E_HBM_GBPS / 1e9
+    from odh_kubeflow_tpu.tpu import telemetry
+
+    telemetry.observe_decode_step(decode_s / (max_new - 1), tokens=batch)
     return {
         "generate_tokens_per_s": round(batch * max_new / elapsed),
         "decode_only_tokens_per_s": round(batch * (max_new - 1) / decode_s),
@@ -643,6 +651,39 @@ def bench_flash_block_overhead():
     }
 
 
+READINESS_PHASES = (
+    "notebook.ready",
+    "webhook.mutate",
+    "reconcile.statefulset",
+    "reconcile.service",
+    "reconcile.route",
+    "reconcile.status",
+    "kubelet.container.start",
+    "probe.first_healthy",
+)
+
+
+def _readiness_phase_breakdown():
+    """Per-phase p50 (ms) of the readiness path, mined from the trace buffer:
+    for each trace, the FIRST occurrence of each phase span (steady-state
+    re-reconciles are not bring-up), then the median across traces."""
+    from odh_kubeflow_tpu.utils import tracing
+
+    by_phase: dict = {name: [] for name in READINESS_PHASES}
+    seen: set = set()
+    for span in tracing.recent_spans():
+        key = (span["trace_id"], span["name"])
+        if span["name"] not in by_phase or key in seen:
+            continue
+        seen.add(key)
+        by_phase[span["name"]].append(span["duration_ms"])
+    return {
+        name: {"p50_ms": round(statistics.median(durs), 3), "traces": len(durs)}
+        for name, durs in by_phase.items()
+        if durs
+    }
+
+
 def bench_control_plane():
     from odh_kubeflow_tpu.api.core import Container
     from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
@@ -650,6 +691,9 @@ def bench_control_plane():
     from odh_kubeflow_tpu.controllers import Config
     from odh_kubeflow_tpu.main import build_manager
     from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.utils import tracing
+
+    tracing.clear()  # this run's traces only
 
     def make_notebook(name, accelerator, topology):
         nb = Notebook()
@@ -701,6 +745,9 @@ def bench_control_plane():
 
     return {
         "cr_to_mesh_ready_p50_s": round(statistics.median(latencies.values()), 4),
+        # where the time goes: per-phase p50 from the connected readiness
+        # traces (root notebook.ready = CR submit -> jax.devices ready)
+        "readiness_phases": _readiness_phase_breakdown(),
         "p90_s": round(statistics.quantiles(latencies.values(), n=10)[-1], 4),
         "multi_host_p50_s": round(
             statistics.median(

@@ -221,6 +221,14 @@ class ApiServer:
         # RETURN (code, body) instead of writing to the socket; the one
         # streaming verb (watch) audits at stream start.
         h._body_consumed = False  # per-request: handlers persist on keep-alive
+        # adopt the caller's W3C trace context for this request thread, so
+        # server-side work (admission webhook callouts included) propagates it
+        from ..utils.tracing import attach
+
+        with attach(h.headers.get("traceparent")):
+            self._dispatch_traced(h, method)
+
+    def _dispatch_traced(self, h: BaseHTTPRequestHandler, method: str) -> None:
         try:
             if not self._authorized(h):
                 raise UnauthorizedError("missing or invalid bearer token")

@@ -197,6 +197,24 @@ class Kubelet:
 
         if pod.status.phase == "Running" and pod.is_ready():
             return None
+        # readiness trace: the container-start window (scheduled -> Ready),
+        # joined to the notebook's trace via the template-propagated
+        # traceparent annotation. Recorded once per incarnation — this branch
+        # only runs on the not-ready -> Ready transition.
+        from ..utils.tracing import TRACEPARENT_ANNOTATION
+
+        traceparent = pod.metadata.annotations.get(TRACEPARENT_ANNOTATION)
+        if traceparent:
+            from ..utils.tracing import record_span
+
+            record_span(
+                "kubelet.container.start",
+                traceparent=traceparent,
+                start_time=time.time() - elapsed,
+                end_time=time.time(),
+                pod=pod.metadata.name,
+                namespace=pod.metadata.namespace,
+            )
         # carry restart counts across status rewrites (crash-restart
         # injection bumps them; a Ready transition must not zero them)
         prior_restarts = {

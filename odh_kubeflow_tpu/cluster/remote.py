@@ -620,6 +620,13 @@ class RemoteStore:
         headers = {"Accept": "application/json"}
         if content_type:
             headers["Content-Type"] = content_type
+        # W3C trace propagation: API calls made under an active span carry
+        # its context, so server-side traces join the caller's
+        from ..utils.tracing import current_traceparent
+
+        traceparent = current_traceparent()
+        if traceparent:
+            headers["traceparent"] = traceparent
         token = self.token
         if self.token_file:
             try:

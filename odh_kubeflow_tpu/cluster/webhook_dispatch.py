@@ -66,10 +66,13 @@ class WebhookDispatcher:
                 pool = self._pools[key] = HostPool(
                     u.scheme, u.hostname, u.port, timeout, context=ctx
                 )
-        status, data = pool.request(
-            "POST", u.path or "/", payload,
-            {"Content-Type": "application/json"},
-        )
+        headers = {"Content-Type": "application/json"}
+        from ..utils.tracing import current_traceparent
+
+        traceparent = current_traceparent()
+        if traceparent:
+            headers["traceparent"] = traceparent
+        status, data = pool.request("POST", u.path or "/", payload, headers)
         if status >= 400:
             raise ConnectionError(f"webhook POST {url} -> {status}")
         return json.loads(data)

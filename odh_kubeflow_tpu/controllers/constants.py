@@ -37,6 +37,12 @@ AUTH_SIDECAR_MEMORY_LIMIT_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-me
 FEAST_LABEL = "opendatahub.io/feast-integration"
 RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
 
+# -- observability --
+# W3C traceparent of the readiness trace, stamped by the webhook at CREATE
+# and copied into the pod template so every actor on the CR-submit ->
+# jax.devices()-ready path (reconciler, kubelet, probe gate) joins ONE trace
+from ..utils.tracing import TRACEPARENT_ANNOTATION  # noqa: E402,F401  (canonical home)
+
 # -- TPU-native additions --
 TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"
 TPU_PROBE_PORT = 8889  # in-pod probe agent (readiness + utilization + activity)

@@ -180,7 +180,17 @@ class TPUWorkbenchReconciler:
             self.reconcile_auth_objects(nb)
         else:
             self.cleanup_auth_objects(nb)
-        self.reconcile_httproute(nb, auth=auth)
+        # route setup is a named phase of the readiness trace (the webhook's
+        # reconciliation lock holds replicas at 0 until this controller is
+        # done, so route time is on the bring-up critical path)
+        from ..utils.tracing import reconcile_tracer
+
+        with reconcile_tracer.start_span(
+            "reconcile.route",
+            traceparent=nb.metadata.annotations.get(C.TRACEPARENT_ANNOTATION),
+            notebook=nb.metadata.name,
+        ):
+            self.reconcile_httproute(nb, auth=auth)
 
         self.remove_reconciliation_lock(nb)
         return None

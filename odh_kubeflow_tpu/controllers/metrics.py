@@ -53,6 +53,11 @@ class NotebookMetrics:
             "Notebook CR to slice-ready latency (the north-star metric)",
             buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300),
         )
+        self.probe_sweep_seconds = registry.histogram(
+            "notebook_probe_sweep_seconds",
+            "Wall-clock of one all-ordinals readiness probe sweep",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 2.5, 5, 10),
+        )
         # fleet capacity, per accelerator type (from Node allocatable — the
         # TPU analog of cluster GPU-capacity dashboards)
         self.tpu_chips_allocatable = registry.gauge(

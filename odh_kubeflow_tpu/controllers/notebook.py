@@ -45,6 +45,7 @@ from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import SliceShape, TPU_RESOURCE, plan_slice, tpu_env, ordinal_env
+from ..utils.tracing import reconcile_tracer
 from . import constants as C
 from .config import Config
 from .metrics import NotebookMetrics
@@ -146,6 +147,11 @@ class NotebookReconciler:
         template = sts.spec.template
         template.metadata.labels = {C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
         template.metadata.annotations = {}
+        # propagate the readiness trace to the pods: the kubelet (sim) and
+        # the in-pod probe agent join the trace via this annotation
+        traceparent = nb.metadata.annotations.get(C.TRACEPARENT_ANNOTATION)
+        if traceparent:
+            template.metadata.annotations[C.TRACEPARENT_ANNOTATION] = traceparent
         template.spec = nb.spec.template.spec.deepcopy()
         self._default_container(nb, template.spec, shape)
 
@@ -266,11 +272,22 @@ class NotebookReconciler:
             return None
 
         shape = self.plan(nb)
-        self._reconcile_statefulset(nb, shape)
-        self._reconcile_service(nb, self.generate_service(nb))
-        self._reconcile_service(nb, self.generate_hosts_service(nb))
-        self._update_status(nb, shape)
-        self._handle_restart(nb)
+        # per-phase child spans of the readiness trace (annotation-carried):
+        # one reconcile = one `reconcile.notebook` span with STS/service/
+        # status children, so bench.py can decompose where bring-up time goes
+        traceparent = nb.metadata.annotations.get(C.TRACEPARENT_ANNOTATION)
+        with reconcile_tracer.start_span(
+            "reconcile.notebook", traceparent=traceparent,
+            notebook=nb.metadata.name, namespace=nb.metadata.namespace,
+        ):
+            with reconcile_tracer.start_span("reconcile.statefulset"):
+                self._reconcile_statefulset(nb, shape)
+            with reconcile_tracer.start_span("reconcile.service"):
+                self._reconcile_service(nb, self.generate_service(nb))
+                self._reconcile_service(nb, self.generate_hosts_service(nb))
+            with reconcile_tracer.start_span("reconcile.status"):
+                self._update_status(nb, shape)
+            self._handle_restart(nb)
         return None
 
     def _reconcile_statefulset(self, nb: Notebook, shape: Optional[SliceShape]) -> None:

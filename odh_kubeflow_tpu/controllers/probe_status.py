@@ -33,6 +33,7 @@ from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import plan_slice
+from ..utils import tracing
 from . import constants as C
 from .config import Config
 from .culling import HTTPGet, _default_http_get
@@ -154,7 +155,13 @@ class ProbeStatusController:
             # host loss or restart.
             return Result(requeue_after=period_s)
 
+        # one timing source for BOTH consumers of the sweep window: the
+        # sweep-duration histogram and the probe.first_healthy trace span
+        probe_t0 = time.time()
         reports = self.collect_reports(nb, shape.hosts)
+        probe_t1 = time.time()
+        if reports:
+            self.metrics.probe_sweep_seconds.observe(probe_t1 - probe_t0)
         chips_visible = sum(int(r.get("chips_visible", 0)) for r in reports if r)
         hosts_reporting_ready = sum(1 for r in reports if r and r.get("ready"))
         mesh_ready = (
@@ -180,6 +187,7 @@ class ProbeStatusController:
                 self.metrics.slice_ready_seconds.observe(time.time() - created)
             except (ValueError, TypeError):
                 pass
+            self._record_ready_trace(nb, shape, chips_visible, probe_t0, probe_t1)
             log.info(
                 "slice ready: %s (%d chips over %d hosts)",
                 req.key,
@@ -189,6 +197,57 @@ class ProbeStatusController:
         # keep polling until the mesh gate is green; afterwards stay on a slow
         # heartbeat so chip loss (e.g. a host losing devices) is re-detected
         return Result(requeue_after=period_s if not mesh_ready else period_s * 6)
+
+    # ---------- readiness trace (terminal spans + root closure) ----------
+
+    def _record_ready_trace(
+        self, nb: Notebook, shape, chips_visible: int, probe_t0: float, probe_t1: float
+    ) -> None:
+        """First mesh-ready: record `probe.first_healthy` (the sweep that saw
+        every host ready) and the terminal `jax.devices.ready` marker, then
+        close the `notebook.ready` root the webhook opened — synthesizing it
+        from creationTimestamp when the root lives in another process."""
+        traceparent = nb.metadata.annotations.get(C.TRACEPARENT_ANNOTATION)
+        ctx = tracing.parse_traceparent(traceparent)
+        if ctx is None:
+            return
+        trace_id, root_span_id = ctx
+        now = time.time()
+        tracing.record_span(
+            "probe.first_healthy",
+            traceparent=traceparent,
+            start_time=probe_t0,
+            end_time=probe_t1,
+            notebook=nb.metadata.name,
+            hosts=shape.hosts,
+        )
+        tracing.record_span(
+            "jax.devices.ready",
+            traceparent=traceparent,
+            start_time=now,
+            end_time=now,
+            notebook=nb.metadata.name,
+            chips_visible=chips_visible,
+        )
+        if tracing.finish_root(trace_id, end_time=now, chips=chips_visible) is None:
+            # root opened elsewhere (remote-mode webhook) or lost to a
+            # restart: synthesize it with the annotation's OWN span id so the
+            # children recorded against it still connect
+            start = now
+            try:
+                start = parse_time(nb.metadata.creation_timestamp).timestamp()
+            except (ValueError, TypeError):
+                pass
+            tracing.record_span(
+                "notebook.ready",
+                trace_id=trace_id,
+                span_id=root_span_id,
+                start_time=start,
+                end_time=now,
+                notebook=nb.metadata.name,
+                namespace=nb.metadata.namespace,
+                chips=chips_visible,
+            )
 
     # ---------- status write (owns ONLY the device-gate fields) ----------
 

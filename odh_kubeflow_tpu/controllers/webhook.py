@@ -42,6 +42,7 @@ from ..cluster.store import AdmissionRequest, Store
 from ..tpu import plan_slice
 from ..utils import parse_quantity
 from ..utils.diff import first_difference
+from ..utils import tracing
 from ..utils.tracing import webhook_tracer
 from . import constants as C
 from .config import Config
@@ -86,8 +87,38 @@ class NotebookWebhook:
     def handle(self, req: AdmissionRequest) -> Dict[str, Any]:
         nb = default_scheme.decode({**req.object, "kind": "Notebook"})
         assert isinstance(nb, Notebook)
+        # readiness trace root: CREATE opens `notebook.ready` (closed by the
+        # probe-status gate at first mesh-ready) and stamps its traceparent
+        # on the CR — every later actor joins this trace via the annotation
+        root = None
+        if (
+            req.operation == "CREATE"
+            and C.TRACEPARENT_ANNOTATION not in nb.metadata.annotations
+        ):
+            root = tracing.begin_root(
+                "notebook.ready",
+                key=nb.key(),  # re-admission of a retried CREATE replaces
+                # the stale root the failed attempt stranded
+                notebook=nb.metadata.name,
+                namespace=nb.metadata.namespace,
+            )
+            if root is not None:
+                nb.metadata.annotations[C.TRACEPARENT_ANNOTATION] = root.traceparent
+        traceparent = nb.metadata.annotations.get(C.TRACEPARENT_ANNOTATION)
+        try:
+            return self._handle_traced(req, nb, traceparent)
+        except Exception:
+            # denied CREATE: the notebook never existed — drop its open root
+            if root is not None:
+                tracing.discard_root(root.trace_id)
+            raise
+
+    def _handle_traced(
+        self, req: AdmissionRequest, nb: Notebook, traceparent: Optional[str]
+    ) -> Dict[str, Any]:
         with webhook_tracer.start_span(
-            "notebook-webhook.handle",
+            "webhook.mutate",
+            traceparent=traceparent,
             notebook=nb.metadata.name,
             namespace=nb.metadata.namespace,
             operation=req.operation,

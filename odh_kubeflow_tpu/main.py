@@ -92,7 +92,14 @@ def main() -> None:  # pragma: no cover - thin CLI shell
     """
     import os
 
-    logging.basicConfig(level=logging.INFO)
+    # structured JSON logs by default (every record carries trace/span ids +
+    # notebook identity via utils/logging.py); LOG_FORMAT=text opts out
+    if os.environ.get("LOG_FORMAT", "json") == "json":
+        from .utils.logging import setup_json_logging
+
+        setup_json_logging(level=logging.INFO)
+    else:
+        logging.basicConfig(level=logging.INFO)
     config = Config.from_env()
     cluster = None
     webhook_server = None

@@ -207,6 +207,14 @@ class JaxTPUMonitor(TPUMonitor):
                 if self._last_mem is not None and mems != self._last_mem:
                     activity = True
                 self._last_mem = mems
+                # publish per-device memory to the shared registry (the
+                # sampler already paid for the memory_stats reads)
+                try:
+                    from ..tpu.telemetry import record_device_memory
+
+                    record_device_memory(mems)
+                except Exception:
+                    pass
             for a in jax.live_arrays():
                 key = id(a)
                 if self._seen_arrays.get(key) is not a:

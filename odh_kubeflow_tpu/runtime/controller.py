@@ -9,6 +9,12 @@ import traceback
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
+from ..utils.logging import log_context
+from .metrics import (
+    reconcile_duration_seconds,
+    reconcile_errors_total,
+    reconcile_total,
+)
 from .workqueue import RateLimiter, WorkQueue
 
 log = logging.getLogger(__name__)
@@ -45,7 +51,7 @@ class Controller:
         self.reconciler = reconciler
         self.workers = workers
         self.max_retries = max_retries
-        self.queue: WorkQueue[Request] = WorkQueue()
+        self.queue: WorkQueue[Request] = WorkQueue(name=name)
         self.rate_limiter = RateLimiter()
         self._threads: List[threading.Thread] = []
         self._stopped = threading.Event()
@@ -77,16 +83,27 @@ class Controller:
             if req is None:
                 return
             try:
-                result = self.reconciler(req)
+                # log_context threads controller + object identity into every
+                # structured log record emitted below this frame
+                with log_context(
+                    controller=self.name, namespace=req.namespace, name=req.name
+                ), reconcile_duration_seconds.time(controller=self.name):
+                    result = self.reconciler(req)
                 self.reconcile_count += 1
                 self.rate_limiter.forget(req)
+                outcome = "success"
                 if result is not None:
                     if result.requeue_after > 0:
+                        outcome = "requeue_after"
                         self.queue.add_after(req, result.requeue_after)
                     elif result.requeue:
+                        outcome = "requeue"
                         self.queue.add_after(req, self.rate_limiter.when(req))
+                reconcile_total.inc(controller=self.name, result=outcome)
             except Exception:
                 self.error_count += 1
+                reconcile_total.inc(controller=self.name, result="error")
+                reconcile_errors_total.inc(controller=self.name)
                 log.error(
                     "reconciler %s failed for %s:\n%s",
                     self.name,

@@ -20,7 +20,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..apimachinery import GoneError, Scheme, default_scheme
 from ..cluster.store import ADDED, DELETED, DROPPED, MODIFIED, Store, WatchEvent
-from .metrics import relists_total, watch_restarts_total
+from .metrics import (
+    informer_last_sync_timestamp_seconds,
+    informer_synced,
+    relists_total,
+    watch_restarts_total,
+)
 
 log = logging.getLogger(__name__)
 
@@ -46,6 +51,7 @@ class Informer:
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
         self.synced = threading.Event()
+        self.synced_at: float = 0.0  # wall time of the last (re)sync
         self._rv: str = ""  # last seen resourceVersion (the resume point)
         # deterministic per-kind jitter stream (no shared global RNG state)
         import random
@@ -101,7 +107,7 @@ class Informer:
         # drain the initial synthetic ADDs, then mark synced
         while w.pending:
             self._dispatch(w.pending.pop(0))
-        self.synced.set()
+        self._mark_synced()
         while not self._stopped.is_set():
             ev = w.get()
             if self._stopped.is_set():
@@ -179,6 +185,7 @@ class Informer:
             w.pending = []
             rv = ""
         relists_total.inc(kind=self.kind)
+        self._mark_synced()  # a relist IS a fresh sync of the cache
         fresh: Dict[str, dict] = {self._key(o): o for o in items}
         with self._lock:
             vanished: List[Tuple[str, dict]] = [
@@ -192,6 +199,14 @@ class Informer:
         if rv:
             self._rv = rv
         return w
+
+    def _mark_synced(self) -> None:
+        import time
+
+        self.synced.set()
+        self.synced_at = time.time()
+        informer_synced.set(1.0, kind=self.kind)
+        informer_last_sync_timestamp_seconds.set(self.synced_at, kind=self.kind)
 
     def _dispatch(self, ev: WatchEvent) -> None:
         key = self._key(ev.object)

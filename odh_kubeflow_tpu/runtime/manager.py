@@ -176,6 +176,24 @@ class Manager:
         else:
             self.client = self.api_reader
         self.metrics = metrics_registry or global_registry
+        # cache-sync age is computed at scrape time (the pull-style collector
+        # pattern); weakref-bound so the registry never pins the manager, and
+        # a GC finalizer UNREGISTERS the collector — the global registry is
+        # process-lifetime, so dead managers' closures must not accumulate
+        # scrape cost forever
+        import weakref
+
+        registry = self.metrics
+
+        def _collect_cache_age() -> None:
+            mgr = ref()
+            if mgr is not None:
+                mgr._collect_informer_ages()
+
+        ref = weakref.ref(
+            self, lambda _r: registry.remove_collector(_collect_cache_age)
+        )
+        registry.add_collector(_collect_cache_age)
         self.controllers: List[Controller] = []
         self._runnables: List[Callable[[], None]] = []  # extra start hooks
         self._started = False
@@ -191,6 +209,16 @@ class Manager:
             # closes the in-flight window)
             elector = self.elector
             self.client.write_fence = lambda: elector.is_leader.is_set()
+
+    def _collect_informer_ages(self) -> None:
+        from .metrics import informer_cache_sync_age_seconds
+
+        now = time.time()
+        for inf in list(self.informers._informers.values()):
+            if inf.synced_at:
+                informer_cache_sync_age_seconds.set(
+                    now - inf.synced_at, kind=inf.kind
+                )
 
     def builder(self, name: str) -> "Builder":
         # deferred: builder imports cluster.store, whose package init reaches

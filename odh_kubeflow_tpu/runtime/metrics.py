@@ -7,7 +7,22 @@ Prometheus text exposition format."""
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def escape_label_value(value: str) -> str:
+    """Text-exposition escaping for label values: backslash, double-quote and
+    newline (in that order — escaping the escapes first). Unescaped quotes or
+    newlines in a label value break every standard scraper's parser."""
+    return (
+        str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (not quotes)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 class _Metric:
@@ -24,7 +39,9 @@ class _Metric:
     def labels_str(self, key: Tuple[str, ...]) -> str:
         if not self.label_names:
             return ""
-        pairs = ",".join(f'{k}="{v}"' for k, v in zip(self.label_names, key))
+        pairs = ",".join(
+            f'{k}="{escape_label_value(v)}"' for k, v in zip(self.label_names, key)
+        )
         return "{" + pairs + "}"
 
 
@@ -53,9 +70,30 @@ class Gauge(_Metric):
             k = self._key(labels)
             self._values[k] = self._values.get(k, 0.0) + amount
 
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
     def value(self, **labels: str) -> float:
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
+
+
+class _HistogramTimer:
+    """`with histogram.time(label=...):` — observes the elapsed wall-clock on
+    exit (monotonic), so instrumentation sites stop hand-rolling
+    time.time() deltas."""
+
+    def __init__(self, histogram: "Histogram", labels: Dict[str, str]):
+        self._histogram = histogram
+        self._labels = labels
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_HistogramTimer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._histogram.observe(time.monotonic() - self._t0, **self._labels)
 
 
 class Histogram(_Metric):
@@ -83,7 +121,12 @@ class Histogram(_Metric):
                 if value <= b:
                     counts[i] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
+            # observations above the largest finite bucket land ONLY in the
+            # +Inf bucket, which renders from this total
             self._totals[k] = self._totals.get(k, 0) + 1
+
+    def time(self, **labels: str) -> _HistogramTimer:
+        return _HistogramTimer(self, labels)
 
     def percentile(self, p: float, **labels: str) -> Optional[float]:
         """Approximate percentile from bucket counts (upper bound of the bucket)."""
@@ -134,6 +177,16 @@ class Registry:
         with self._lock:
             self._collectors.append(fn)
 
+    def remove_collector(self, fn: Callable[[], None]) -> None:
+        """Unregister a collector (owners with shorter lifetimes than this
+        registry — e.g. Managers against the global registry — must remove
+        theirs, or scrape cost grows with every owner ever created)."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
     def render(self) -> str:
         with self._lock:
             collectors = list(self._collectors)
@@ -142,15 +195,26 @@ class Registry:
             fn()
         lines: List[str] = []
         for m in metrics:
-            lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# HELP {m.name} {escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.type_name}")
             if isinstance(m, Histogram):
                 with m._lock:
                     for k, counts in m._counts.items():
                         cumulative_labels = m.labels_str(k)
+
+                        def le_labels(le: str, base: str = cumulative_labels) -> str:
+                            if base:
+                                return "{" + base[1:-1] + f',le="{le}"' + "}"
+                            return f'{{le="{le}"}}'
+
                         for b, c in zip(m.buckets, counts):
-                            le = ("{" + cumulative_labels[1:-1] + f',le="{b}"' + "}") if cumulative_labels else f'{{le="{b}"}}'
-                            lines.append(f"{m.name}_bucket{le} {c}")
+                            lines.append(f"{m.name}_bucket{le_labels(str(b))} {c}")
+                        # the mandatory +Inf bucket == total observations:
+                        # without it, scrapers reject the family and values
+                        # above the largest finite bucket vanish entirely
+                        lines.append(
+                            f'{m.name}_bucket{le_labels("+Inf")} {m._totals[k]}'
+                        )
                         lines.append(f"{m.name}_sum{cumulative_labels} {m._sums[k]}")
                         lines.append(f"{m.name}_count{cumulative_labels} {m._totals[k]}")
             else:
@@ -195,4 +259,64 @@ breaker_trips_total = global_registry.counter(
 fenced_writes_total = global_registry.counter(
     "fenced_writes_total",
     "Writes refused by leader-election fencing (lease not held)",
+)
+
+# ---- controller-runtime-standard telemetry (ISSUE 2): the workqueue /
+# reconcile / informer series every controller dashboard expects, emitted by
+# runtime/workqueue.py, runtime/controller.py and runtime/informer.py ----
+
+_QUEUE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30, 60)
+
+workqueue_depth = global_registry.gauge(
+    "workqueue_depth",
+    "Items currently waiting in the workqueue, by queue name",
+    labels=("name",),
+)
+workqueue_adds_total = global_registry.counter(
+    "workqueue_adds_total",
+    "Items enqueued (dedup'd re-adds excluded), by queue name",
+    labels=("name",),
+)
+workqueue_queue_duration_seconds = global_registry.histogram(
+    "workqueue_queue_duration_seconds",
+    "How long an item waits in the queue before a worker picks it up",
+    labels=("name",),
+    buckets=_QUEUE_BUCKETS,
+)
+workqueue_retries_total = global_registry.counter(
+    "workqueue_retries_total",
+    "Delayed re-adds (backoff/RequeueAfter) into the workqueue, by queue name",
+    labels=("name",),
+)
+reconcile_duration_seconds = global_registry.histogram(
+    "controller_reconcile_duration_seconds",
+    "Wall-clock per reconcile invocation, by controller",
+    labels=("controller",),
+    buckets=_QUEUE_BUCKETS,
+)
+reconcile_total = global_registry.counter(
+    "controller_reconcile_total",
+    "Reconcile results (success | requeue | requeue_after | error), by controller",
+    labels=("controller", "result"),
+)
+reconcile_errors_total = global_registry.counter(
+    "controller_reconcile_errors_total",
+    "Reconciles that raised, by controller",
+    labels=("controller",),
+)
+informer_synced = global_registry.gauge(
+    "informer_synced",
+    "Whether the informer cache has completed its initial sync (1/0), by kind",
+    labels=("kind",),
+)
+informer_last_sync_timestamp_seconds = global_registry.gauge(
+    "informer_last_sync_timestamp_seconds",
+    "Unix time the informer cache last (re)synced (initial sync or relist), by kind",
+    labels=("kind",),
+)
+informer_cache_sync_age_seconds = global_registry.gauge(
+    "informer_cache_sync_age_seconds",
+    "Seconds since the informer cache last (re)synced, by kind (set at scrape "
+    "by the manager's collector)",
+    labels=("kind",),
 )

@@ -42,13 +42,40 @@ class ServingEndpoints:
                 pass
 
             def do_GET(self):
-                if self.path.split("?")[0] != "/metrics":
+                from urllib.parse import parse_qs, urlparse
+
+                parsed = urlparse(self.path)
+                path = parsed.path
+                if path == "/metrics":
+                    body = registry.render().encode()
+                    serving._respond(
+                        self, 200, body, content_type="text/plain; version=0.0.4"
+                    )
+                elif path == "/debug/traces":
+                    # recent completed spans as JSON; ?trace_id= narrows to
+                    # one trace (e.g. a notebook's readiness decomposition)
+                    import json
+
+                    from ..utils import tracing
+
+                    query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+                    spans = tracing.recent_spans(
+                        trace_id=query.get("trace_id"), name=query.get("name")
+                    )
+                    serving._respond(
+                        self,
+                        200,
+                        json.dumps({"spans": spans}).encode(),
+                        content_type="application/json",
+                    )
+                elif path == "/healthz":
+                    # mirrored here so one port serves the whole debug mux
+                    ok = serving.manager.healthz()
+                    serving._respond(
+                        self, 200 if ok else 500, b"ok\n" if ok else b"unhealthy\n"
+                    )
+                else:
                     serving._respond(self, 404, b"not found\n")
-                    return
-                body = registry.render().encode()
-                serving._respond(
-                    self, 200, body, content_type="text/plain; version=0.0.4"
-                )
 
         class HealthHandler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"

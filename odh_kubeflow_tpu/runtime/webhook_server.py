@@ -81,6 +81,14 @@ class WebhookServer:
     # -- request handling --
 
     def _handle(self, h: BaseHTTPRequestHandler) -> None:
+        # join the apiserver's trace (it forwards the client's traceparent on
+        # the callout) so webhook spans connect across the wire
+        from ..utils.tracing import attach
+
+        with attach(h.headers.get("traceparent")):
+            self._handle_traced(h)
+
+    def _handle_traced(self, h: BaseHTTPRequestHandler) -> None:
         try:
             handler = self._handlers.get(h.path.split("?")[0].rstrip("/") or "/")
             if handler is None:

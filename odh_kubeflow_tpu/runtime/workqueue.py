@@ -14,6 +14,13 @@ import threading
 import time
 from typing import Dict, Generic, Hashable, List, Optional, Set, Tuple, TypeVar
 
+from .metrics import (
+    workqueue_adds_total,
+    workqueue_depth,
+    workqueue_queue_duration_seconds,
+    workqueue_retries_total,
+)
+
 K = TypeVar("K", bound=Hashable)
 
 
@@ -42,17 +49,28 @@ class RateLimiter:
 
 
 class WorkQueue(Generic[K]):
-    def __init__(self) -> None:
+    def __init__(self, name: str = "") -> None:
+        self.name = name
         self._cond = threading.Condition()
         self._queue: List[K] = []
         self._queued: Set[K] = set()
         self._processing: Set[K] = set()
         self._dirty: Set[K] = set()
+        self._added_at: Dict[K, float] = {}  # key -> monotonic enqueue time
         self._delayed: List[Tuple[float, int, K]] = []  # heap of (when, seq, key)
         self._seq = 0
         self._shutdown = False
         self._delay_thread = threading.Thread(target=self._delay_loop, daemon=True)
         self._delay_thread.start()
+
+    def _enqueue_locked(self, key: K) -> None:
+        """Append under self._cond: the single site that grows the queue, so
+        depth/adds/latency telemetry can never drift from the real queue."""
+        self._queued.add(key)
+        self._queue.append(key)
+        self._added_at.setdefault(key, time.monotonic())
+        workqueue_adds_total.inc(name=self.name)
+        workqueue_depth.set(len(self._queue), name=self.name)
 
     def add(self, key: K) -> None:
         with self._cond:
@@ -63,8 +81,7 @@ class WorkQueue(Generic[K]):
                 return
             if key in self._queued:
                 return
-            self._queued.add(key)
-            self._queue.append(key)
+            self._enqueue_locked(key)
             self._cond.notify_all()
 
     def add_after(self, key: K, delay: float) -> None:
@@ -76,6 +93,7 @@ class WorkQueue(Generic[K]):
                 return
             self._seq += 1
             heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, key))
+            workqueue_retries_total.inc(name=self.name)
             self._cond.notify_all()
 
     def _delay_loop(self) -> None:
@@ -88,8 +106,7 @@ class WorkQueue(Generic[K]):
                 while self._delayed and self._delayed[0][0] <= now:
                     _, _, key = heapq.heappop(self._delayed)
                     if key not in self._processing and key not in self._queued:
-                        self._queued.add(key)
-                        self._queue.append(key)
+                        self._enqueue_locked(key)
                         self._cond.notify_all()
                     elif key in self._processing:
                         self._dirty.add(key)
@@ -113,6 +130,12 @@ class WorkQueue(Generic[K]):
             key = self._queue.pop(0)
             self._queued.discard(key)
             self._processing.add(key)
+            added = self._added_at.pop(key, None)
+            if added is not None:
+                workqueue_queue_duration_seconds.observe(
+                    time.monotonic() - added, name=self.name
+                )
+            workqueue_depth.set(len(self._queue), name=self.name)
             return key
 
     def done(self, key: K) -> None:
@@ -121,8 +144,7 @@ class WorkQueue(Generic[K]):
             if key in self._dirty:
                 self._dirty.discard(key)
                 if key not in self._queued:
-                    self._queued.add(key)
-                    self._queue.append(key)
+                    self._enqueue_locked(key)
                     self._cond.notify_all()
 
     def shutdown(self) -> None:

@@ -1,0 +1,110 @@
+"""TPU-side telemetry: workload signals in the same registry the manager
+scrapes (ISSUE 2 tentpole).
+
+The control plane can say how fast a slice came up; these series say what the
+slice is DOING once up — train/decode step-time histograms, throughput and
+MFU gauges, and per-device memory. Sources:
+
+- explicit observations from the workload host loop (`observe_train_step` /
+  `observe_decode_step`: bench.py and any training driver call these at the
+  same place they already compute tokens/s),
+- the in-pod probe agent's runtime-state sampler (probe/agent.py), which
+  feeds `record_device_memory` from the per-device memory_stats it already
+  collects for activity detection — no extra device round-trips.
+
+Everything registers idempotently on the global registry, so the manager's
+`/metrics`, the probe agent's process, and a notebook kernel all share one
+series set when co-located (the sim), and partition naturally when not.
+"""
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple
+
+from ..runtime.metrics import global_registry
+
+_STEP_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)
+
+train_step_seconds = global_registry.histogram(
+    "tpu_train_step_duration_seconds",
+    "Per-step wall-clock of the training loop (host-observed, jit dispatch "
+    "amortized by the caller's timing method)",
+    buckets=_STEP_BUCKETS,
+)
+decode_step_seconds = global_registry.histogram(
+    "tpu_decode_step_duration_seconds",
+    "Per-token wall-clock of autoregressive decode",
+    buckets=_STEP_BUCKETS,
+)
+tokens_per_second = global_registry.gauge(
+    "tpu_tokens_per_second",
+    "Most recent throughput, by phase (train | decode)",
+    labels=("phase",),
+)
+mfu = global_registry.gauge(
+    "tpu_mfu",
+    "Most recent model-FLOPs utilization (0-1), by phase (train | decode)",
+    labels=("phase",),
+)
+device_memory_bytes = global_registry.gauge(
+    "tpu_device_memory_bytes",
+    "Bytes in use per local device (from the runtime's memory_stats)",
+    labels=("device",),
+)
+
+
+def observe_train_step(
+    step_s: float,
+    tokens: Optional[float] = None,
+    mfu_est: Optional[float] = None,
+) -> None:
+    """One training step: step wall-clock, plus derived throughput/MFU when
+    the caller knows them (bench.py passes its slope-measured values)."""
+    train_step_seconds.observe(step_s)
+    if tokens is not None and step_s > 0:
+        tokens_per_second.set(tokens / step_s, phase="train")
+    if mfu_est is not None:
+        mfu.set(mfu_est, phase="train")
+
+
+def observe_decode_step(
+    step_s: float,
+    tokens: Optional[float] = None,
+    mfu_est: Optional[float] = None,
+) -> None:
+    decode_step_seconds.observe(step_s)
+    if tokens is not None and step_s > 0:
+        tokens_per_second.set(tokens / step_s, phase="decode")
+    if mfu_est is not None:
+        mfu.set(mfu_est, phase="decode")
+
+
+def record_device_memory(
+    mems: Iterable[Tuple[Optional[float], Optional[float]]]
+) -> None:
+    """Publish per-device bytes-in-use from (bytes_in_use, num_allocs) pairs
+    (the probe agent's sampler shape); devices are labeled by local index."""
+    for i, (bytes_in_use, _allocs) in enumerate(mems):
+        if bytes_in_use is not None:
+            device_memory_bytes.set(float(bytes_in_use), device=str(i))
+
+
+def update_device_memory() -> int:
+    """Scrape jax.local_devices() memory_stats directly (for hosts that run
+    no probe agent); returns devices published. Never raises — a CPU-only or
+    jax-less process simply publishes nothing."""
+    try:
+        import jax
+
+        devices: Sequence = jax.local_devices()
+    except Exception:
+        return 0
+    published = 0
+    for i, d in enumerate(devices):
+        try:
+            stats = getattr(d, "memory_stats", lambda: None)()
+        except Exception:
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            device_memory_bytes.set(float(stats["bytes_in_use"]), device=str(i))
+            published += 1
+    return published

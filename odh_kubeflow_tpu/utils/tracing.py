@@ -1,15 +1,80 @@
-"""Minimal OpenTelemetry-shaped tracing, from scratch.
+"""W3C-trace-context tracing, from scratch.
 
-The reference traces only the webhook (reference odh notebook_webhook.go:29-31,
-70-72, spans at :358-365,509-510, span events at :834,850,883), with a no-op
-global provider in production and an in-memory exporter in tests
-(opentelemetry_test.go:26-77). Same surface here."""
+The seed traced only the webhook with parent-pointer spans (reference odh
+notebook_webhook.go:29-31, spans at :358-365; in-memory exporter shaped like
+opentelemetry_test.go:26-77). This layer upgrades that to real 128/64-bit
+trace/span IDs with `traceparent` propagation so ONE trace can decompose the
+north-star latency (Notebook CR -> `jax.devices()` ready) across components:
+
+- the webhook opens the root `notebook.ready` span and stamps its traceparent
+  onto the Notebook as an annotation (controllers/constants.py
+  TRACEPARENT_ANNOTATION); the core reconciler copies it into the pod
+  template, so every later actor — reconciler, kubelet sim, probe agent,
+  probe-status gate — can join the same trace from the object in hand,
+- in-process context is a thread-local span stack SHARED by all tracers
+  (current_traceparent() is what RemoteStore/webhook callouts inject as the
+  `traceparent` HTTP header; attach() adopts an incoming header server-side),
+- completed spans land in one process-wide ring buffer, served as JSON by the
+  manager's `/debug/traces` endpoint and mined by bench.py for the
+  phase-by-phase readiness breakdown.
+
+Tracing is ON by default and cheap (a dataclass + deque append per span);
+set_enabled(False) turns every start into a no-op for overhead A/Bs
+(tests/test_tracing.py bounds the calm-path cost).
+"""
 from __future__ import annotations
 
+import collections
+import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# W3C trace-context primitives
+# ---------------------------------------------------------------------------
+
+# canonical home of the trace annotation key: both the controllers package
+# (controllers/constants.py re-exports it) and the cluster side (kubelet sim)
+# need it, and neither may import the other at module load
+TRACEPARENT_ANNOTATION = "notebooks.tpu.kubeflow.org/traceparent"
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def format_traceparent(trace_id: str, span_id: str, sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple]:
+    """`00-{trace-id}-{parent-id}-{flags}` -> (trace_id, span_id), or None
+    for anything malformed (all-zero ids are invalid per the spec)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _version, trace_id, span_id, _flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+            return None
+    except ValueError:
+        return None
+    return trace_id.lower(), span_id.lower()
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
 
 
 @dataclass
@@ -22,11 +87,23 @@ class SpanEvent:
 @dataclass
 class Span:
     name: str
+    trace_id: str = ""
+    span_id: str = ""
+    parent_id: str = ""
     attributes: Dict[str, Any] = field(default_factory=dict)
     events: List[SpanEvent] = field(default_factory=list)
-    parent: Optional["Span"] = None
+    parent: Optional["Span"] = None  # in-process parent (back-compat surface)
     start_time: float = 0.0
     end_time: float = 0.0
+    recording: bool = True  # attach()ed remote contexts propagate, not record
+
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
+
+    @property
+    def duration(self) -> float:
+        return max(0.0, self.end_time - self.start_time)
 
     def set_attribute(self, key: str, value: Any) -> None:
         self.attributes[key] = value
@@ -37,39 +114,327 @@ class Span:
     def end(self) -> None:
         self.end_time = time.time()
 
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "duration_ms": round(self.duration * 1e3, 3),
+            "attributes": dict(self.attributes),
+            "events": [
+                {"name": e.name, "timestamp": e.timestamp, "attributes": dict(e.attributes)}
+                for e in self.events
+            ],
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-wide context + export
+# ---------------------------------------------------------------------------
+
+_ctx = threading.local()  # .stack: List[Span] — shared by ALL tracers
+
+
+def _stack() -> List[Span]:
+    stack = getattr(_ctx, "stack", None)
+    if stack is None:
+        stack = _ctx.stack = []
+    return stack
+
+
+def current_span() -> Optional[Span]:
+    stack = getattr(_ctx, "stack", None)
+    return stack[-1] if stack else None
+
+
+def current_traceparent() -> Optional[str]:
+    span = current_span()
+    return span.traceparent if span is not None else None
+
+
+_enabled = True
+
+
+def set_enabled(on: bool) -> None:
+    """Global kill switch: False turns every span start into a no-op (the
+    overhead A/B in tests/test_tracing.py runs the reconcile loop both ways)."""
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class TraceBuffer:
+    """Ring buffer of completed spans — the /debug/traces backing store."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._spans: "collections.deque[Span]" = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def append(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def spans(self, trace_id: Optional[str] = None, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if trace_id is not None:
+            out = [s for s in out if s.trace_id == trace_id]
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+global_buffer = TraceBuffer()
+
+
+def recent_spans(trace_id: Optional[str] = None, name: Optional[str] = None) -> List[dict]:
+    """Completed spans as JSON-ready dicts (newest last) — the /debug/traces
+    payload and bench.py's phase-decomposition source."""
+    return [s.to_dict() for s in global_buffer.spans(trace_id=trace_id, name=name)]
+
+
+def clear() -> None:
+    global_buffer.clear()
+    with _roots_lock:
+        _open_roots.clear()
+        _root_id_by_key.clear()
+        _key_by_root_id.clear()
+
+
+# ---------------------------------------------------------------------------
+# Tracer
+# ---------------------------------------------------------------------------
+
+class _NoopSpan(Span):
+    """Shared no-op span handed out while tracing is disabled: attribute and
+    event writes vanish (a shared mutable span would accumulate them)."""
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        pass
+
+    def end(self) -> None:
+        pass
+
+
+_NOOP = _NoopSpan(name="", recording=False)
+
 
 class Tracer:
-    """No-op by default; attach an InMemoryExporter to record."""
+    """Named span factory. All tracers share the thread-local context stack
+    and the global buffer; a per-tracer InMemoryExporter can additionally be
+    attached (the seed's test surface, kept)."""
 
     def __init__(self, name: str = ""):
         self.name = name
         self.exporter: Optional["InMemoryExporter"] = None
-        self._local = threading.local()
 
-    def start_span(self, name: str, **attributes: Any) -> "SpanContext":
-        parent = getattr(self._local, "current", None)
-        span = Span(name=name, attributes=dict(attributes), parent=parent,
-                    start_time=time.time())
+    def start_span(
+        self, name: str, traceparent: Optional[str] = None, **attributes: Any
+    ) -> "SpanContext":
+        if not _enabled:
+            return SpanContext(self, _NOOP, push=False)
+        parent = current_span()
+        trace_id, parent_id = "", ""
+        ctx = parse_traceparent(traceparent)
+        if ctx is not None:
+            trace_id, parent_id = ctx
+        elif parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        span = Span(
+            name=name,
+            trace_id=trace_id or new_trace_id(),
+            span_id=new_span_id(),
+            parent_id=parent_id,
+            attributes=dict(attributes),
+            parent=parent,
+            start_time=time.time(),
+        )
         return SpanContext(self, span)
 
     def _record(self, span: Span) -> None:
+        if not span.recording:
+            return
+        global_buffer.append(span)
         if self.exporter is not None:
             self.exporter.spans.append(span)
 
 
 class SpanContext:
-    def __init__(self, tracer: Tracer, span: Span):
+    def __init__(self, tracer: Tracer, span: Span, push: bool = True):
         self.tracer = tracer
         self.span = span
+        self._push = push
 
     def __enter__(self) -> Span:
-        self.tracer._local.current = self.span
+        if self._push:
+            _stack().append(self.span)
         return self.span
 
     def __exit__(self, *exc) -> None:
+        if not self._push:
+            return
         self.span.end()
-        self.tracer._local.current = self.span.parent
+        stack = _stack()
+        if stack and stack[-1] is self.span:
+            stack.pop()
         self.tracer._record(self.span)
+
+
+class _Attached:
+    """Context manager that adopts a remote traceparent (HTTP header) as the
+    current context WITHOUT recording a span — server-side propagation."""
+
+    def __init__(self, span: Optional[Span]):
+        self.span = span
+
+    def __enter__(self) -> Optional[Span]:
+        if self.span is not None:
+            _stack().append(self.span)
+        return self.span
+
+    def __exit__(self, *exc) -> None:
+        if self.span is not None:
+            stack = _stack()
+            if stack and stack[-1] is self.span:
+                stack.pop()
+
+
+def attach(traceparent: Optional[str]) -> _Attached:
+    """Adopt an incoming `traceparent` header for the current thread (no-op
+    for absent/malformed headers): spans started inside become children of
+    the remote caller's span."""
+    ctx = parse_traceparent(traceparent) if _enabled else None
+    if ctx is None:
+        return _Attached(None)
+    trace_id, span_id = ctx
+    return _Attached(
+        Span(name="remote-parent", trace_id=trace_id, span_id=span_id, recording=False)
+    )
+
+
+def record_span(
+    name: str,
+    traceparent: Optional[str] = None,
+    start_time: Optional[float] = None,
+    end_time: Optional[float] = None,
+    trace_id: Optional[str] = None,
+    span_id: Optional[str] = None,
+    **attributes: Any,
+) -> Optional[Span]:
+    """Record an already-complete span (known start/end) under `traceparent`
+    — the one-shot form for phase boundaries observed after the fact, e.g.
+    the kubelet sim's container-start window."""
+    if not _enabled:
+        return None
+    parent_trace, parent_span = "", ""
+    ctx = parse_traceparent(traceparent)
+    if ctx is not None:
+        parent_trace, parent_span = ctx
+    now = time.time()
+    span = Span(
+        name=name,
+        trace_id=trace_id or parent_trace or new_trace_id(),
+        span_id=span_id or new_span_id(),
+        parent_id=parent_span,
+        attributes=dict(attributes),
+        start_time=start_time if start_time is not None else now,
+        end_time=end_time if end_time is not None else now,
+    )
+    global_buffer.append(span)
+    return span
+
+
+# ---------------------------------------------------------------------------
+# Long-lived root spans (the CR-submit -> jax.devices.ready envelope)
+# ---------------------------------------------------------------------------
+
+_open_roots: Dict[str, Span] = {}  # trace_id -> open root span
+_root_id_by_key: Dict[str, str] = {}  # dedup key (e.g. ns/name) -> trace_id
+_key_by_root_id: Dict[str, str] = {}  # reverse, for cleanup on finish/evict
+_roots_lock = threading.Lock()
+# roots that never finish (CPU notebooks, deletes before ready) must not
+# grow without bound: oldest-first eviction past this cap
+_MAX_OPEN_ROOTS = 2048
+
+
+def _drop_root_locked(trace_id: str) -> Optional[Span]:
+    span = _open_roots.pop(trace_id, None)
+    key = _key_by_root_id.pop(trace_id, None)
+    if key is not None and _root_id_by_key.get(key) == trace_id:
+        _root_id_by_key.pop(key, None)
+    return span
+
+
+def begin_root(name: str, key: Optional[str] = None, **attributes: Any) -> Optional[Span]:
+    """Open a root span that outlives any one call stack (the webhook opens
+    `notebook.ready` here at CREATE admission; the probe-status gate closes
+    it at first mesh-ready). A `key` (e.g. "ns/name") dedups re-openings:
+    retried CREATEs whose earlier attempt failed AFTER admission would
+    otherwise strand one root per attempt. Returns None when disabled."""
+    if not _enabled:
+        return None
+    span = Span(
+        name=name,
+        trace_id=new_trace_id(),
+        span_id=new_span_id(),
+        attributes=dict(attributes),
+        start_time=time.time(),
+    )
+    with _roots_lock:
+        if key is not None:
+            stale = _root_id_by_key.get(key)
+            if stale is not None:
+                _drop_root_locked(stale)
+            _root_id_by_key[key] = span.trace_id
+            _key_by_root_id[span.trace_id] = key
+        while len(_open_roots) >= _MAX_OPEN_ROOTS:
+            _drop_root_locked(next(iter(_open_roots)))  # insertion order = oldest
+        _open_roots[span.trace_id] = span
+    return span
+
+
+def finish_root(trace_id: str, end_time: Optional[float] = None, **attributes: Any) -> Optional[Span]:
+    """Close + export the open root for `trace_id`; None if unknown (e.g. the
+    root was opened in another process — callers then synthesize via
+    record_span with the annotation's ids)."""
+    with _roots_lock:
+        span = _drop_root_locked(trace_id)
+    if span is None:
+        return None
+    span.attributes.update(attributes)
+    span.end_time = end_time if end_time is not None else time.time()
+    global_buffer.append(span)
+    return span
+
+
+def open_root(trace_id: str) -> Optional[Span]:
+    with _roots_lock:
+        return _open_roots.get(trace_id)
+
+
+def discard_root(trace_id: str) -> None:
+    """Drop an open root without exporting it (an admission denial after the
+    webhook opened the root must not leak the entry, nor record a phantom
+    readiness trace)."""
+    with _roots_lock:
+        _drop_root_locked(trace_id)
 
 
 class InMemoryExporter:
@@ -80,5 +445,7 @@ class InMemoryExporter:
         return [s for s in self.spans if s.name == name]
 
 
-# module-level default, like the OTel global tracer provider
+# module-level defaults, like the OTel global tracer provider
 webhook_tracer = Tracer("notebook-webhook")
+reconcile_tracer = Tracer("notebook-reconciler")
+probe_tracer = Tracer("probe-status")

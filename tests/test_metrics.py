@@ -1,7 +1,236 @@
 """Controller metrics (controllers/metrics.py): reference's five series +
-the TPU-native gauges (chips bound, per-accelerator capacity)."""
+the TPU-native gauges (chips bound, per-accelerator capacity) — plus the
+Prometheus text-exposition contract (ISSUE 2 satellites): a round-trip
+parser validates HELP/TYPE ordering, counter `_total` naming, cumulative
+histogram buckets and the mandatory `le="+Inf"` bucket, and label-value
+escaping, against both synthetic registries and the LIVE global registry
+after a fault-injection scenario."""
+import re
+
+import pytest
+
 from odh_kubeflow_tpu.api.core import Container, ResourceRequirements
 from odh_kubeflow_tpu.controllers import constants as C
+
+# ---------------------------------------------------------------------------
+# text-exposition parser (the scraper's view, minimal but strict)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$")
+_UNESCAPE = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+def _parse_labels(raw: str) -> dict:
+    labels = {}
+    i = 0
+    while i < len(raw):
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', raw[i:])
+        assert m, f"bad label segment at {raw[i:]!r}"
+        key = m.group(1)
+        i += m.end()
+        val = []
+        while True:
+            assert i < len(raw), f"unterminated label value in {raw!r}"
+            ch = raw[i]
+            if ch == "\\":
+                esc = raw[i + 1]
+                assert esc in _UNESCAPE, f"bad escape \\{esc} in {raw!r}"
+                val.append(_UNESCAPE[esc])
+                i += 2
+            elif ch == '"':
+                i += 1
+                break
+            else:
+                assert ch != "\n"
+                val.append(ch)
+                i += 1
+        labels[key] = "".join(val)
+        if i < len(raw) and raw[i] == ",":
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str) -> dict:
+    """{family: {"help": str, "type": str, "samples": [(name, labels, value)]}}.
+    Asserts the structural contract a standard scraper enforces: HELP/TYPE
+    precede samples, every sample belongs to a declared family, values parse
+    as floats."""
+    families: dict = {}
+    current = None
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            families[name] = {"help": help_, "type": None, "samples": []}
+            current = name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, type_ = rest.partition(" ")
+            assert name in families, f"TYPE before HELP for {name}"
+            families[name]["type"] = type_
+            current = name
+            continue
+        assert not line.startswith("#"), f"unknown comment line {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line {line!r}"
+        sample_name, _, raw_labels, raw_value = m.groups()
+        family = sample_name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if family not in families and family.endswith(suffix):
+                family = family[: -len(suffix)]
+        assert family in families, f"sample {sample_name} has no HELP/TYPE"
+        assert current == family, f"sample {sample_name} outside its family block"
+        labels = _parse_labels(raw_labels) if raw_labels else {}
+        value = float(raw_value)  # raises on junk
+        families[family]["samples"].append((sample_name, labels, value))
+    return families
+
+
+def assert_conventions(families: dict) -> None:
+    """Naming + histogram-shape conventions (the metrics-lint contract)."""
+    for name, fam in families.items():
+        assert fam["type"] in ("counter", "gauge", "histogram"), name
+        if fam["type"] == "counter":
+            assert name.endswith("_total"), f"counter {name} missing _total"
+        if fam["type"] == "histogram":
+            by_series: dict = {}
+            for sample_name, labels, value in fam["samples"]:
+                if sample_name == f"{name}_bucket":
+                    key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+                    by_series.setdefault(key, {})[labels["le"]] = value
+            for key, buckets in by_series.items():
+                assert "+Inf" in buckets, f"{name}{dict(key)} missing +Inf bucket"
+                finite = sorted(
+                    (float(le), c) for le, c in buckets.items() if le != "+Inf"
+                )
+                counts = [c for _, c in finite] + [buckets["+Inf"]]
+                assert counts == sorted(counts), f"{name} buckets not cumulative"
+                count_samples = [
+                    v
+                    for sn, labels, v in fam["samples"]
+                    if sn == f"{name}_count"
+                    and tuple(sorted(labels.items())) == key
+                ]
+                assert count_samples and count_samples[0] == buckets["+Inf"], (
+                    f"{name}_count != +Inf bucket"
+                )
+
+
+# ---------------------------------------------------------------------------
+# exposition-format unit tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.observability
+def test_histogram_renders_inf_bucket_and_counts_overflow():
+    """Observations above the largest finite bucket must still appear — in
+    the +Inf bucket (and _count/_sum); the seed dropped them entirely."""
+    from odh_kubeflow_tpu.runtime.metrics import Registry
+
+    registry = Registry()
+    h = registry.histogram("req_seconds", "request latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(50.0)  # beyond the largest bucket
+    families = parse_exposition(registry.render())
+    assert_conventions(families)
+    buckets = {
+        labels["le"]: v
+        for name, labels, v in families["req_seconds"]["samples"]
+        if name == "req_seconds_bucket"
+    }
+    assert buckets["0.1"] == 1 and buckets["1.0"] == 1
+    assert buckets["+Inf"] == 2  # the overflow observation is visible
+    sums = [v for n, _, v in families["req_seconds"]["samples"] if n == "req_seconds_sum"]
+    assert sums == [pytest.approx(50.05)]
+
+
+@pytest.mark.observability
+def test_label_values_escaped():
+    """Quotes, backslashes and newlines in label values must round-trip
+    through the exposition text (the seed emitted them raw)."""
+    from odh_kubeflow_tpu.runtime.metrics import Registry
+
+    registry = Registry()
+    c = registry.counter("weird_total", "weird labels", labels=("reason",))
+    hostile = 'say "hi"\\path\nnewline'
+    c.inc(reason=hostile)
+    text = registry.render()
+    families = parse_exposition(text)
+    assert_conventions(families)
+    (sample,) = families["weird_total"]["samples"]
+    assert sample[1]["reason"] == hostile  # escape -> parse round-trip
+    assert "\n".join(text.splitlines()) == text.rstrip("\n")  # no broken lines
+
+
+@pytest.mark.observability
+def test_help_newlines_escaped():
+    from odh_kubeflow_tpu.runtime.metrics import Registry
+
+    registry = Registry()
+    registry.counter("multi_total", "line one\nline two")
+    families = parse_exposition(registry.render())
+    assert families["multi_total"]["help"] == "line one\\nline two"
+
+
+@pytest.mark.observability
+def test_gauge_dec_and_histogram_time():
+    from odh_kubeflow_tpu.runtime.metrics import Registry
+
+    registry = Registry()
+    g = registry.gauge("inflight", "in-flight ops", labels=("queue",))
+    g.inc(queue="q")
+    g.inc(queue="q")
+    g.dec(queue="q")
+    assert g.value(queue="q") == 1.0
+
+    h = registry.histogram("op_seconds", "op latency", labels=("queue",), buckets=(0.5, 5))
+    with h.time(queue="q"):
+        pass
+    assert h._totals[("q",)] == 1
+    assert h._sums[("q",)] < 0.5  # the no-op block cannot take half a second
+
+
+@pytest.mark.observability
+def test_live_registry_exposition_after_fault_scenario():
+    """The GLOBAL registry (everything the manager serves on /metrics) parses
+    cleanly and satisfies the conventions after a fault-injection scenario
+    has exercised the resilience counters (watch drop -> restart/relist)."""
+    from odh_kubeflow_tpu.api.core import Pod
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.runtime.metrics import global_registry, watch_restarts_total
+
+    with SimCluster() as cluster:
+        cluster.add_cpu_pool("cpu", nodes=1)
+        before = watch_restarts_total.value(kind="Pod")
+        cluster.store.sever_watches(kind="Pod")
+        deadline = __import__("time").monotonic() + 10
+        while __import__("time").monotonic() < deadline:
+            if watch_restarts_total.value(kind="Pod") > before:
+                break
+            __import__("time").sleep(0.01)
+        assert watch_restarts_total.value(kind="Pod") > before
+        cluster.system.wait_idle(timeout=10)
+        families = parse_exposition(global_registry.render())
+    assert_conventions(families)
+    # the controller-runtime-standard series are live
+    for family in (
+        "workqueue_depth",
+        "workqueue_adds_total",
+        "workqueue_queue_duration_seconds",
+        "controller_reconcile_duration_seconds",
+        "controller_reconcile_total",
+        "informer_synced",
+        "informer_last_sync_timestamp_seconds",
+    ):
+        assert family in families, family
+    assert any(
+        labels.get("kind") == "Pod" and v >= 1
+        for name, labels, v in families["informer_watch_restarts_total"]["samples"]
+    )
+
 
 def test_metrics_scrape_counts_clamped_sts_and_capacity():
     """The running-notebook scrape matches clamped STS names (long notebook

@@ -1,0 +1,394 @@
+"""End-to-end readiness tracing (ISSUE 2 tentpole): W3C traceparent
+primitives, cross-component propagation through the sim (webhook ->
+reconciler phases -> kubelet -> probe gate -> jax.devices.ready), the
+/debug/traces endpoint, structured JSON logs with trace correlation, and the
+calm-path overhead bound."""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.utils import tracing
+
+pytestmark = pytest.mark.observability
+
+
+@pytest.fixture(autouse=True)
+def _clean_traces():
+    tracing.set_enabled(True)
+    tracing.clear()
+    yield
+    tracing.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    trace_id, span_id = tracing.new_trace_id(), tracing.new_span_id()
+    header = tracing.format_traceparent(trace_id, span_id)
+    assert tracing.parse_traceparent(header) == (trace_id, span_id)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        None,
+        "",
+        "garbage",
+        "00-short-short-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",  # all-zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "00-" + "z" * 32 + "-" + "1" * 16 + "-01",  # non-hex
+    ],
+)
+def test_traceparent_rejects_malformed(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+def test_span_nesting_and_remote_parent():
+    tracer = tracing.Tracer("t")
+    with tracer.start_span("parent") as parent:
+        assert tracing.current_traceparent() == parent.traceparent
+        with tracer.start_span("child") as child:
+            assert child.trace_id == parent.trace_id
+            assert child.parent_id == parent.span_id
+    # explicit traceparent (the annotation/header path) overrides ambient
+    header = tracing.format_traceparent("ab" * 16, "cd" * 8)
+    with tracer.start_span("remote-child", traceparent=header) as span:
+        assert span.trace_id == "ab" * 16
+        assert span.parent_id == "cd" * 8
+    names = [s["name"] for s in tracing.recent_spans()]
+    assert names == ["child", "parent", "remote-child"]  # completion order
+
+
+def test_attach_adopts_header_without_recording():
+    tracer = tracing.Tracer("t")
+    header = tracing.format_traceparent("ef" * 16, "12" * 8)
+    with tracing.attach(header):
+        assert tracing.current_traceparent() == header
+        with tracer.start_span("inside") as span:
+            assert span.trace_id == "ef" * 16
+    assert [s["name"] for s in tracing.recent_spans()] == ["inside"]
+
+
+def test_disabled_records_nothing():
+    tracing.set_enabled(False)
+    tracer = tracing.Tracer("t")
+    with tracer.start_span("off") as span:
+        span.set_attribute("k", "v")  # must not raise on the no-op span
+    assert tracing.begin_root("off-root") is None
+    assert tracing.record_span("off-oneshot") is None
+    assert tracing.recent_spans() == []
+
+
+def test_root_dedup_by_key():
+    """Re-opening a root under the same key (a retried CREATE whose earlier
+    attempt failed after admission) replaces the stale root instead of
+    stranding it."""
+    first = tracing.begin_root("notebook.ready", key="ns/nb")
+    second = tracing.begin_root("notebook.ready", key="ns/nb")
+    assert tracing.open_root(first.trace_id) is None  # stale one dropped
+    assert tracing.open_root(second.trace_id) is second
+    assert tracing.finish_root(second.trace_id) is second
+    assert tracing._open_roots == {} and tracing._root_id_by_key == {}
+
+
+def test_root_lifecycle_and_discard():
+    root = tracing.begin_root("root", who="test")
+    assert tracing.open_root(root.trace_id) is root
+    done = tracing.finish_root(root.trace_id, chips=4)
+    assert done is root and done.attributes["chips"] == 4
+    assert tracing.finish_root(root.trace_id) is None  # once only
+    orphan = tracing.begin_root("orphan")
+    tracing.discard_root(orphan.trace_id)
+    names = [s["name"] for s in tracing.recent_spans()]
+    assert names == ["root"]  # the discarded root never exported
+
+
+# ---------------------------------------------------------------------------
+# the connected readiness trace (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def _ready_notebook(cluster, name="nb-trace", namespace="obs", timeout=30.0):
+    from odh_kubeflow_tpu.api.core import Container
+    from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = namespace
+    nb.spec.template.spec.containers = [Container(name=name, image="jupyter:latest")]
+    nb.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+    cluster.client.create(nb)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        cur = cluster.client.get(Notebook, namespace, name)
+        if cur.status.tpu and cur.status.tpu.mesh_ready:
+            return cur
+        time.sleep(0.02)
+    raise AssertionError(f"{namespace}/{name} never mesh-ready")
+
+
+def test_connected_readiness_trace_and_debug_endpoint():
+    """One sim run yields ONE connected trace: root `notebook.ready` covers
+    CR-submit -> jax.devices.ready and carries webhook / reconcile-phase /
+    kubelet / probe children; /debug/traces serves it as JSON."""
+    import urllib.request
+
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config
+    from odh_kubeflow_tpu.controllers import constants as C
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+
+    cluster = SimCluster().start()
+    agents: dict = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=1)
+    mgr = build_manager(
+        cluster.store, Config(readiness_probe_period_s=0.1), http_get=cluster.http_get
+    )
+    mgr.start()
+    endpoints = mgr.serve_endpoints(metrics_port=0, health_port=0, host="127.0.0.1")
+    try:
+        nb = _ready_notebook(cluster)
+        header = nb.metadata.annotations.get(C.TRACEPARENT_ANNOTATION)
+        ctx = tracing.parse_traceparent(header)
+        assert ctx is not None, "webhook must stamp a valid traceparent at CREATE"
+        trace_id, root_span_id = ctx
+
+        # the mesh_ready status write lands a beat BEFORE the probe
+        # controller records the terminal spans — wait for the root to close
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if tracing.recent_spans(trace_id=trace_id, name="notebook.ready"):
+                break
+            time.sleep(0.02)
+
+        spans = tracing.recent_spans(trace_id=trace_id)
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        for phase in (
+            "webhook.mutate",
+            "reconcile.notebook",
+            "reconcile.statefulset",
+            "reconcile.service",
+            "reconcile.route",
+            "kubelet.container.start",
+            "probe.first_healthy",
+            "jax.devices.ready",
+            "notebook.ready",
+        ):
+            assert phase in by_name, f"missing phase span {phase}"
+        (root,) = by_name["notebook.ready"]
+        assert root.get("span_id") == root_span_id
+        # the root envelope covers the bring-up (FIRST) occurrence of every
+        # phase; steady-state re-reconciles after mesh-ready may outlive it
+        for name, group in by_name.items():
+            if name == "notebook.ready":
+                continue
+            first = min(group, key=lambda s: s["start_time"])
+            assert first["start_time"] >= root["start_time"] - 0.001, name
+            assert first["end_time"] <= root["end_time"] + 0.001, name
+        # direct children hang off the root span id
+        assert by_name["webhook.mutate"][0]["parent_id"] == root_span_id
+        assert by_name["kubelet.container.start"][0]["parent_id"] == root_span_id
+
+        # /debug/traces serves the same spans over HTTP, filterable by trace
+        host, port = endpoints.metrics_address
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/traces?trace_id={trace_id}", timeout=5
+        ) as resp:
+            payload = json.loads(resp.read())
+        served = {s["name"] for s in payload["spans"]}
+        assert "notebook.ready" in served and "kubelet.container.start" in served
+        assert all(s["trace_id"] == trace_id for s in payload["spans"])
+        # /healthz rides the same mux
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=5
+        ) as resp:
+            assert resp.status == 200
+    finally:
+        endpoints.stop()
+        mgr.stop()
+        cluster.stop()
+
+
+def test_bench_phase_breakdown_reports_all_phases():
+    """bench.py's breakdown helper decomposes the trace buffer into per-phase
+    p50s (the artifact consumers read)."""
+    import bench
+
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+
+    cluster = SimCluster().start()
+    agents: dict = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=1)
+    mgr = build_manager(
+        cluster.store, Config(readiness_probe_period_s=0.1), http_get=cluster.http_get
+    )
+    mgr.start()
+    try:
+        _ready_notebook(cluster, name="nb-bench")
+        # see test_connected_readiness_trace: wait out the write-to-span gap
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            breakdown = bench._readiness_phase_breakdown()
+            if "notebook.ready" in breakdown:
+                break
+            time.sleep(0.02)
+    finally:
+        mgr.stop()
+        cluster.stop()
+    for phase in ("notebook.ready", "webhook.mutate", "kubelet.container.start",
+                  "probe.first_healthy"):
+        assert phase in breakdown, phase
+        assert breakdown[phase]["p50_ms"] >= 0
+        assert breakdown[phase]["traces"] >= 1
+
+
+def test_webhook_denial_discards_root():
+    """A denied CREATE must not leak an open root span."""
+    from odh_kubeflow_tpu.cluster import Client, Store
+    from odh_kubeflow_tpu.api.core import Container
+    from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+    from odh_kubeflow_tpu.apimachinery import AdmissionDeniedError
+    from odh_kubeflow_tpu.controllers.webhook import NotebookWebhook
+
+    store = Store()
+    client = Client(store)
+    NotebookWebhook(client).register(store)
+    nb = Notebook()
+    nb.metadata.name = "bad-tpu"
+    nb.metadata.namespace = "obs"
+    nb.spec.template.spec.containers = [Container(name="bad-tpu", image="i")]
+    nb.spec.tpu = TPUSpec(accelerator="v5e", topology="9x9x9")
+    with pytest.raises(AdmissionDeniedError):
+        client.create(nb)
+    assert tracing._open_roots == {}
+    assert tracing.recent_spans(name="notebook.ready") == []
+
+
+# ---------------------------------------------------------------------------
+# structured JSON logs
+# ---------------------------------------------------------------------------
+
+
+def test_json_log_formatter_injects_trace_and_identity():
+    from odh_kubeflow_tpu.utils.logging import JSONLogFormatter, log_context
+
+    formatter = JSONLogFormatter()
+    logger = logging.getLogger("obs-test")
+    tracer = tracing.Tracer("t")
+    with log_context(controller="notebook", namespace="obs", name="nb-1"):
+        with tracer.start_span("logged") as span:
+            record = logger.makeRecord(
+                "obs-test", logging.INFO, __file__, 1, "hello %s", ("world",), None
+            )
+            line = formatter.format(record)
+    out = json.loads(line)
+    assert out["message"] == "hello world"
+    assert out["level"] == "INFO"
+    assert out["controller"] == "notebook"
+    assert out["namespace"] == "obs" and out["name"] == "nb-1"
+    assert out["trace_id"] == span.trace_id
+    assert out["span_id"] == span.span_id
+    assert out["ts"].endswith("Z")
+
+
+def test_log_context_nests_and_restores():
+    from odh_kubeflow_tpu.utils.logging import current_log_context, log_context
+
+    with log_context(controller="a"):
+        with log_context(namespace="b"):
+            assert current_log_context() == {"controller": "a", "namespace": "b"}
+        assert current_log_context() == {"controller": "a"}
+    assert current_log_context() == {}
+
+
+def test_reconcile_logs_carry_identity():
+    """The controller worker binds controller/namespace/name around the
+    reconciler, so any record logged inside carries the identity."""
+    from odh_kubeflow_tpu.runtime.controller import Controller
+    from odh_kubeflow_tpu.utils.logging import current_log_context
+
+    seen = {}
+    done = threading.Event()
+
+    def reconciler(req):
+        seen.update(current_log_context())
+        done.set()
+        return None
+
+    ctrl = Controller("obs-ctl", reconciler)
+    ctrl.start()
+    try:
+        ctrl.enqueue("obs", "nb-7")
+        assert done.wait(5)
+    finally:
+        ctrl.stop()
+    assert seen == {"controller": "obs-ctl", "namespace": "obs", "name": "nb-7"}
+
+
+# ---------------------------------------------------------------------------
+# calm-path overhead (tier-1 bound)
+# ---------------------------------------------------------------------------
+
+
+def _reconcile_loop_wall(n: int) -> float:
+    """Wall-clock for n single-worker reconciles of a traced no-op reconciler
+    through the full Controller/WorkQueue machinery."""
+    from odh_kubeflow_tpu.runtime.controller import Controller
+    from odh_kubeflow_tpu.utils.tracing import reconcile_tracer
+
+    count = [0]
+    done = threading.Event()
+
+    def reconciler(req):
+        with reconcile_tracer.start_span("overhead.reconcile"):
+            pass
+        count[0] += 1
+        if count[0] >= n:
+            done.set()
+        return None
+
+    ctrl = Controller("overhead", reconciler)
+    ctrl.start()
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            ctrl.enqueue("obs", f"nb-{i}")
+        assert done.wait(60)
+        return time.perf_counter() - t0
+    finally:
+        ctrl.stop()
+
+
+def test_tracing_overhead_negligible_on_calm_path():
+    """Tracing + metrics must not tax the calm path: the added wall-clock per
+    reconcile with tracing ENABLED vs DISABLED stays under 2 ms (generous —
+    measured sub-50us; the bound only catches pathological regressions like
+    lock contention or per-span I/O)."""
+    n = 300
+    _reconcile_loop_wall(50)  # warm imports/threads before measuring
+    tracing.set_enabled(False)
+    t_disabled = min(_reconcile_loop_wall(n) for _ in range(2))
+    tracing.set_enabled(True)
+    t_enabled = min(_reconcile_loop_wall(n) for _ in range(2))
+    added_per_reconcile = max(0.0, t_enabled - t_disabled) / n
+    assert added_per_reconcile < 0.002, (
+        f"tracing adds {added_per_reconcile * 1e3:.3f} ms per reconcile "
+        f"(enabled {t_enabled:.3f}s vs disabled {t_disabled:.3f}s over {n})"
+    )
