@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Operator-lint lane (ISSUE 3): the AST invariant checks over the whole
+# package — cache-mutation, lock-discipline, lock-order, swallowed-exception,
+# metric/annotation conventions — followed by the checker contract tests
+# (every checker must flag its fixture violation AND pass its clean twin).
+#
+# Exit is nonzero on ANY unsuppressed finding: intentional exceptions live as
+# inline `# lint: disable=<check>` pragmas next to a justification comment,
+# so this lane going red means a NEW invariant violation, never a known one.
+#
+#   ./ci/analysis.sh                 # full pass + contract tests
+#   ./ci/analysis.sh --audit         # also show what the pragmas suppress
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== operator-lint static pass =="
+python -m odh_kubeflow_tpu.analysis odh_kubeflow_tpu
+
+if [[ "${1:-}" == "--audit" ]]; then
+    echo "== suppressed findings (pragma audit) =="
+    python -m odh_kubeflow_tpu.analysis --include-suppressed odh_kubeflow_tpu || true
+fi
+
+if python -m pytest --version >/dev/null 2>&1; then
+    echo "== analysis contract tests =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q -m analysis \
+        -p no:cacheprovider -p no:randomly
+else
+    # the static pass above is dependency-free and already gated; only the
+    # pytest contract layer is skipped in a bare environment
+    echo "== pytest unavailable: contract tests skipped (static pass gated) =="
+fi

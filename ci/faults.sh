@@ -26,4 +26,13 @@ for i in $(seq 1 "$REPEAT"); do
         -p no:cacheprovider -p no:randomly "$@"
 done
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green ==="
+# one more pass with the runtime race detector armed (utils/racecheck.py):
+# instrumented locks raise deterministically on any acquisition-order
+# inversion the chaos run exercises, and the informer cache's write barrier
+# raises on in-place mutation of cache-owned objects — every chaos soak
+# doubles as a race run
+echo "=== faults lane: RACECHECK=1 iteration ==="
+RACECHECK=1 python -m pytest tests/test_faults.py -q -m "faults and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck) ==="

@@ -11,6 +11,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 rc=0
+# operator-lint: the in-tree AST invariant checks (ci/analysis.sh) — unlike
+# ruff/mypy these have no dependencies, so they gate everywhere, including
+# the hermetic dev image
+echo "== operator-lint (ci/analysis.sh) =="
+./ci/analysis.sh || rc=1
+
 if python -m ruff --version >/dev/null 2>&1; then
     echo "== ruff check =="
     python -m ruff check odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py || rc=1

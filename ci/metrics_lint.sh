@@ -1,58 +1,15 @@
 #!/usr/bin/env bash
-# Metrics exposition lint lane (ISSUE 2 satellite): import the package,
-# instantiate every metric-registration site, render the GLOBAL registry and
-# fail on naming-convention violations (counters without `_total`, metrics
-# with empty help strings, invalid metric names) plus any exposition text a
-# standard scraper would reject. Then rerun the observability-marked pytest
-# contract tests (exposition round-trip, +Inf buckets, label escaping).
+# Metrics exposition lint lane (ISSUE 2 satellite; rules ported to Python in
+# ISSUE 3): delegate the registry naming/exposition rules to the analysis
+# package — odh_kubeflow_tpu/analysis/metric_rules.py is the ONE source of
+# truth, shared with the static metric-convention AST checker — then rerun
+# the observability-marked pytest contract tests (exposition round-trip,
+# +Inf buckets, label escaping).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== registry lint =="
-python - <<'PY'
-import re
-import sys
-
-# Import every module that registers series at import or construction time.
-import odh_kubeflow_tpu.runtime.metrics as m  # resilience + controller-runtime series
-import odh_kubeflow_tpu.runtime.workqueue  # noqa: F401
-import odh_kubeflow_tpu.runtime.controller  # noqa: F401
-import odh_kubeflow_tpu.tpu.telemetry  # noqa: F401  # TPU-side series
-from odh_kubeflow_tpu.controllers.metrics import NotebookMetrics
-
-NotebookMetrics(m.global_registry)  # controller series register in __init__
-
-NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
-LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
-violations = []
-for metric in m.global_registry._metrics.values():
-    if not NAME_RE.match(metric.name):
-        violations.append(f"{metric.name}: invalid metric name")
-    if isinstance(metric, m.Counter) and not metric.name.endswith("_total"):
-        violations.append(f"{metric.name}: counter without _total suffix")
-    if not metric.help.strip():
-        violations.append(f"{metric.name}: empty help string")
-    for label in metric.label_names:
-        if not LABEL_RE.match(label) or label == "le":
-            violations.append(f"{metric.name}: invalid label name {label!r}")
-
-text = m.global_registry.render()
-families = set()
-for line in text.splitlines():
-    if line.startswith("# HELP "):
-        families.add(line.split(" ", 3)[2])
-for metric in m.global_registry._metrics.values():
-    if metric.name not in families:
-        violations.append(f"{metric.name}: missing from rendered exposition")
-
-if violations:
-    print("metrics lint FAILED:")
-    for v in violations:
-        print(f"  - {v}")
-    sys.exit(1)
-print(f"metrics lint OK: {len(m.global_registry._metrics)} families, "
-      f"{len(text.splitlines())} exposition lines")
-PY
+echo "== registry lint (delegated to odh_kubeflow_tpu.analysis) =="
+python -m odh_kubeflow_tpu.analysis --registry-lint
 
 echo "== observability contract tests =="
 python -m pytest tests/ -q -m observability -p no:cacheprovider

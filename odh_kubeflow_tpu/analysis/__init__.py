@@ -1,0 +1,36 @@
+"""Operator-lint: AST invariant checks for the control plane.
+
+The Go reference inherits `go vet`, `golangci-lint` and `-race` for free;
+this package is the Python reproduction's equivalent correctness-tooling
+layer. A small framework (`framework.py`) walks the package, parses every
+module once, and runs pluggable checkers that enforce operator-specific
+invariants the generic linters cannot know about:
+
+- ``cache-mutation``   objects read from an informer cache must be
+                       deep-copied before any in-place write
+                       (checkers/cache_mutation.py)
+- ``lock-discipline``  no sleeps / network / callback dispatch / re-entrant
+                       acquisition inside a ``with lock:`` body, plus a
+                       global lock-acquisition-order cycle check
+                       (checkers/lock_discipline.py)
+- ``swallowed-exception``  no bare/blind except in reconcile, webhook or
+                       probe paths (checkers/exceptions.py)
+- ``metric-convention`` / ``annotation-convention``  Prometheus naming and
+                       constants.py-sourced annotation keys
+                       (checkers/conventions.py)
+
+Intentional exceptions are recorded inline with ``# lint: disable=<check>``
+pragmas (comma-separated check names, or ``all``); `ci/analysis.sh` runs the
+whole pass and fails on any unsuppressed finding. The runtime half of the
+tooling — the instrumented lock + cache write barrier that turns chaos runs
+into race runs — lives in `odh_kubeflow_tpu/utils/racecheck.py`.
+"""
+from .framework import (  # noqa: F401
+    Checker,
+    Finding,
+    ModuleInfo,
+    all_checkers,
+    run_analysis,
+    run_on_source,
+)
+from .metric_rules import check_registry  # noqa: F401

@@ -1,0 +1,24 @@
+"""Checker registry: one place that knows every checker class."""
+from __future__ import annotations
+
+from typing import List
+
+from ..framework import Checker
+from .cache_mutation import CacheMutationChecker
+from .conventions import AnnotationConventionChecker, MetricConventionChecker
+from .exceptions import SwallowedExceptionChecker
+from .lock_discipline import LockDisciplineChecker, LockOrderChecker
+
+
+def make_checkers() -> List[Checker]:
+    discipline = LockDisciplineChecker()
+    return [
+        CacheMutationChecker(),
+        discipline,
+        # shares discipline's walk: edges are harvested once, cycles
+        # reported at finish()
+        LockOrderChecker(shared=discipline),
+        SwallowedExceptionChecker(),
+        MetricConventionChecker(),
+        AnnotationConventionChecker(),
+    ]

@@ -1,0 +1,119 @@
+"""`python -m odh_kubeflow_tpu.analysis` — the ci/analysis.sh entry point.
+
+    python -m odh_kubeflow_tpu.analysis odh_kubeflow_tpu      # full pass
+    python -m odh_kubeflow_tpu.analysis --check lock-discipline path/
+    python -m odh_kubeflow_tpu.analysis --include-suppressed  # audit pragmas
+    python -m odh_kubeflow_tpu.analysis --registry-lint       # live-registry
+                                    # naming rules (ci/metrics_lint.sh lane)
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .framework import all_checkers, run_analysis
+
+
+def _registry_lint() -> int:
+    """Import every metric-registration site, then lint the live global
+    registry — the Python half metrics_lint.sh delegates to."""
+    import odh_kubeflow_tpu.runtime.controller  # noqa: F401
+    import odh_kubeflow_tpu.runtime.metrics as m
+    import odh_kubeflow_tpu.runtime.workqueue  # noqa: F401
+    import odh_kubeflow_tpu.tpu.telemetry  # noqa: F401
+    from odh_kubeflow_tpu.controllers.metrics import NotebookMetrics
+
+    from .metric_rules import check_registry
+
+    NotebookMetrics(m.global_registry)  # controller series register in __init__
+    violations = check_registry(m.global_registry)
+    if violations:
+        print("metrics lint FAILED:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    text = m.global_registry.render()
+    print(
+        f"metrics lint OK: {len(m.global_registry._metrics)} families, "
+        f"{len(text.splitlines())} exposition lines"
+    )
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m odh_kubeflow_tpu.analysis",
+        description="Operator-lint: AST invariant checks for the control plane",
+    )
+    parser.add_argument("paths", nargs="*", default=[], help="files or directories")
+    parser.add_argument(
+        "--check", action="append", default=None,
+        help="run only this checker (repeatable)",
+    )
+    parser.add_argument(
+        "--include-suppressed", action="store_true",
+        help="show findings hidden by `# lint: disable=` pragmas",
+    )
+    parser.add_argument(
+        "--list-checks", action="store_true", help="list checker names and exit"
+    )
+    parser.add_argument(
+        "--registry-lint", action="store_true",
+        help="lint the live metrics registry instead of source files",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_checks:
+        for checker in all_checkers():
+            print(checker.name)
+        return 0
+    if args.registry_lint:
+        return _registry_lint()
+
+    if args.paths:
+        paths = args.paths
+    else:
+        # resolve the default from the installed package location, not the
+        # cwd — `python -m odh_kubeflow_tpu.analysis` must scan the same
+        # tree no matter where it is invoked from
+        import odh_kubeflow_tpu
+
+        paths = [str(Path(odh_kubeflow_tpu.__file__).parent)]
+    checkers = all_checkers()
+    if args.check:
+        known = {c.name for c in checkers}
+        unknown = set(args.check) - known
+        if unknown:
+            print(f"unknown checker(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            print(f"available: {', '.join(sorted(known))}", file=sys.stderr)
+            return 2
+        selected = set(args.check)
+        checkers = [c for c in checkers if c.name in selected]
+        if "lock-order" in selected and "lock-discipline" not in selected:
+            # lock-order normally piggybacks on lock-discipline's walk; run
+            # standalone when discipline was filtered out
+            from .checkers.lock_discipline import LockOrderChecker
+
+            checkers = [
+                LockOrderChecker() if c.name == "lock-order" else c
+                for c in checkers
+            ]
+
+    findings = run_analysis(
+        paths, checkers=checkers, include_suppressed=args.include_suppressed
+    )
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"\n{len(findings)} finding(s)")
+        return 1
+    print("analysis OK: no findings")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Prometheus naming rules — the ONE source of truth shared by the static
+AST checker (checkers/conventions.py) and the runtime registry lint that
+`ci/metrics_lint.sh` delegates to.
+
+These started life as an inline grep in metrics_lint.sh; the rules are
+byte-for-byte the same here so the lane's contract did not change when the
+shell script became a thin wrapper.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def check_metric(
+    name: str,
+    type_name: str,
+    help_text: Optional[str],
+    label_names: Sequence[str] = (),
+) -> List[str]:
+    """Violation strings for one metric family (empty = compliant)."""
+    violations: List[str] = []
+    if not METRIC_NAME_RE.match(name):
+        violations.append(f"{name}: invalid metric name")
+    if type_name == "counter" and not name.endswith("_total"):
+        violations.append(f"{name}: counter without _total suffix")
+    if help_text is not None and not help_text.strip():
+        violations.append(f"{name}: empty help string")
+    for label in label_names:
+        if not LABEL_NAME_RE.match(label) or label == "le":
+            violations.append(f"{name}: invalid label name {label!r}")
+    return violations
+
+
+def check_registry(registry) -> List[str]:
+    """Runtime lint of a live Registry: naming rules over every registered
+    family, plus the exposition-completeness check (every family must appear
+    in render() output — a family a scraper cannot see is a dead metric)."""
+    violations: List[str] = []
+    for metric in registry._metrics.values():
+        violations.extend(
+            check_metric(metric.name, metric.type_name, metric.help, metric.label_names)
+        )
+    text = registry.render()
+    families = set()
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            families.add(line.split(" ", 3)[2])
+    for metric in registry._metrics.values():
+        if metric.name not in families:
+            violations.append(f"{metric.name}: missing from rendered exposition")
+    return violations

@@ -51,6 +51,7 @@ from ..apimachinery import (
     match_labels,
 )
 from .store import Store, Watch
+from ..utils import racecheck
 
 # admission callout hook: (operation, object, old_object) -> mutated object.
 # Task of the webhook dispatcher (webhook/dispatch.py); None = store-only
@@ -130,7 +131,7 @@ class ApiServer:
         # debug escape (envtest's audit-log dump analog, reference odh
         # controllers/suite_test.go:125-155): JSON-lines request log
         self.audit_path = audit_path
-        self._audit_lock = threading.Lock()
+        self._audit_lock = racecheck.make_lock("ApiServer._audit_lock")
         self.store = store
         self.scheme = scheme
         self.mapper = RESTMapper()
@@ -139,7 +140,7 @@ class ApiServer:
         self.admission = admission
         self._stopping = threading.Event()
         self._active_watches: List[Watch] = []
-        self._watch_lock = threading.Lock()
+        self._watch_lock = racecheck.make_lock("ApiServer._watch_lock")
 
         server = self
 

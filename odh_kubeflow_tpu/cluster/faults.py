@@ -33,11 +33,11 @@ Fault sites (the `site` strings components consult):
 from __future__ import annotations
 
 import random
-import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..apimachinery import ConflictError, GoneError, TooManyRequestsError
+from ..utils import racecheck
 
 
 @dataclass
@@ -89,7 +89,7 @@ class FaultInjector:
     """
 
     def __init__(self, seed: Optional[int] = None):
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("FaultInjector._lock")
         self._rules: List[FaultRule] = []
         self.rng = random.Random(seed)
         self._stores: List[Any] = []  # bound Stores, for sever_watches

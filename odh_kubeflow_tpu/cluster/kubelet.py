@@ -9,7 +9,6 @@ an actual socket."""
 from __future__ import annotations
 
 import itertools
-import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
@@ -17,6 +16,7 @@ from ..api.core import ContainerState, ContainerStatus, Pod
 from ..apimachinery import Condition, ConflictError, NotFoundError, now_rfc3339
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
+from ..utils import racecheck
 
 _ip_seq = itertools.count(2)
 
@@ -44,7 +44,7 @@ class Kubelet:
         # pod key -> (pod uid, host, port, close_fn|None); uid detects recreation
         self._servers: Dict[str, tuple] = {}
         self._started_at: Dict[str, Tuple[str, float]] = {}  # key -> (uid, t0)
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("Kubelet._lock")
 
     def add_behavior(self, behavior: Behavior) -> None:
         with self._lock:

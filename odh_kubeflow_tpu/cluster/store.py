@@ -22,7 +22,6 @@ import copy
 import itertools
 import json
 import queue
-import threading
 import uuid
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Type
@@ -41,6 +40,7 @@ from ..apimachinery import (
     match_labels,
     now_rfc3339,
 )
+from ..utils import racecheck
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -298,7 +298,10 @@ class Store:
         self.faults = faults
         if faults is not None:
             faults.bind_store(self)
-        self._lock = threading.RLock()
+        # instrumented under RACECHECK=1: the in-process admission chain
+        # runs under this lock, so its acquisition order against the
+        # informer/registry locks is the control plane's hottest ABBA risk
+        self._lock = racecheck.make_rlock("Store._lock")
         self._rv = itertools.count(1)
         self._last_rv = 0
         # Watch cache: per-storage-key retained (rv, event) history so watches
@@ -415,7 +418,15 @@ class Store:
         if self.faults is not None:
             self.faults.check("store.write", kind=kind, obj=obj, verb="create")
         with self._lock:
-            obj = self._run_admission(AdmissionRequest(operation="CREATE", object=obj))
+            # intentional: the in-process admission chain runs under the
+            # Store lock so admission + persist are one atomic step (the
+            # real apiserver serializes per-object the same way). Webhook
+            # handlers therefore must not take locks ordered before the
+            # Store's — see InformerRegistry.peek, which is deliberately
+            # lock-free for exactly this reason.
+            obj = self._run_admission(  # lint: disable=lock-discipline
+                AdmissionRequest(operation="CREATE", object=obj)
+            )
             meta = obj.setdefault("metadata", {})
             name = meta.get("name", "")
             if not name:
@@ -525,7 +536,9 @@ class Store:
                     merged["status"] = current["status"]
                 else:
                     merged.pop("status", None)
-                merged = self._run_admission(
+                # intentional: same atomic admission+persist contract as
+                # create_raw above (handlers must stay Store-lock-clean)
+                merged = self._run_admission(  # lint: disable=lock-discipline
                     AdmissionRequest(
                         operation="UPDATE",
                         object=merged,

@@ -18,7 +18,6 @@ import base64
 import json
 import logging
 import ssl
-import threading
 import urllib.request
 from typing import Any, Dict, Optional
 
@@ -30,6 +29,7 @@ from ..apimachinery import (
     json_patch_apply,
 )
 from .store import Store
+from ..utils import racecheck
 
 log = logging.getLogger(__name__)
 
@@ -51,7 +51,7 @@ class WebhookDispatcher:
         # handshake per callout would tax exactly the hot path
         # (kube-apiserver pools its webhook transports the same way)
         self._pools: Dict[tuple, Any] = {}
-        self._pools_lock = threading.Lock()
+        self._pools_lock = racecheck.make_lock("WebhookDispatcher._pools_lock")
 
     def _post_pooled(self, url: str, payload: bytes, ctx, timeout: float) -> dict:
         from urllib.parse import urlsplit

@@ -45,6 +45,9 @@ from ..utils.tracing import TRACEPARENT_ANNOTATION  # noqa: E402,F401  (canonica
 
 # -- TPU-native additions --
 TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"
+# stamped on Events the mirror controller creates, and checked on ingest, so
+# a mirrored Event is never re-mirrored into an infinite loop
+TPU_MIRRORED_EVENT_ANNOTATION = "notebooks.tpu.kubeflow.org/mirrored"
 TPU_PROBE_PORT = 8889  # in-pod probe agent (readiness + utilization + activity)
 TPU_IDLE_ANNOTATION = "notebooks.tpu.kubeflow.org/tpu-last-busy"
 

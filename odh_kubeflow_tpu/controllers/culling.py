@@ -149,7 +149,11 @@ class CullingReconciler:
         for url in urls:
             try:
                 data = self._get_json(url)
-            except Exception:
+            except Exception as e:
+                # per-host degradation is expected (multi-host slices probe
+                # every ordinal; a rebooting host must not veto the verdict)
+                # but it must be visible when someone goes looking
+                log.debug("culling: tpu probe %s unreachable: %s", url, e)
                 continue
             reached += 1
             if float(data.get("duty_cycle", 0.0)) > self.config.tpu_idle_threshold:

@@ -562,7 +562,7 @@ class EventMirrorController:
             inv = obj.get("involvedObject", {})
             return inv.get("kind") in ("Pod", "StatefulSet") and not obj.get(
                 "metadata", {}
-            ).get("annotations", {}).get("notebooks.tpu.kubeflow.org/mirrored")
+            ).get("annotations", {}).get(C.TPU_MIRRORED_EVENT_ANNOTATION)
 
         (
             self.manager.builder("event-mirror")
@@ -595,7 +595,7 @@ class EventMirrorController:
             ev = self.client.get(Event, req.namespace, req.name)
         except NotFoundError:
             return None
-        if ev.metadata.annotations.get("notebooks.tpu.kubeflow.org/mirrored"):
+        if ev.metadata.annotations.get(C.TPU_MIRRORED_EVENT_ANNOTATION):
             return None
         if ev.involved_object.kind not in ("Pod", "StatefulSet"):
             return None
@@ -605,7 +605,7 @@ class EventMirrorController:
         mirrored = Event()
         mirrored.metadata.name = f"{nb.metadata.name}.{ev.metadata.uid[:8]}"
         mirrored.metadata.namespace = nb.metadata.namespace
-        mirrored.metadata.annotations = {"notebooks.tpu.kubeflow.org/mirrored": "true"}
+        mirrored.metadata.annotations = {C.TPU_MIRRORED_EVENT_ANNOTATION: "true"}
         mirrored.involved_object = ObjectReference(
             api_version=nb.api_version or "kubeflow.org/v1beta1",
             kind="Notebook",

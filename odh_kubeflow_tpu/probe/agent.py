@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 from ..apimachinery import rfc3339 as _utc
+from ..utils import racecheck
 
 
 class TPUMonitor:
@@ -109,7 +110,7 @@ class JaxTPUMonitor(TPUMonitor):
         # elapsed since then — the monitor refuses an idleness verdict
         # before one window of evidence exists
         self._sampling_since: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("JaxTPUMonitor._lock")
         if metrics_port is None:
             ports = os.environ.get("TPU_RUNTIME_METRICS_PORTS", "")
             metrics_port = int(ports.split(",")[0]) if ports.strip() else 0
@@ -213,7 +214,10 @@ class JaxTPUMonitor(TPUMonitor):
                     from ..tpu.telemetry import record_device_memory
 
                     record_device_memory(mems)
-                except Exception:
+                # intentional: telemetry is best-effort — a broken optional
+                # import must never take down the activity sampler, and the
+                # in-pod agent has no logger to degrade into
+                except Exception:  # lint: disable=swallowed-exception
                     pass
             for a in jax.live_arrays():
                 key = id(a)
@@ -361,7 +365,7 @@ class NotebookAgent:
         self.kernels = kernels or KernelState()
         self.base_path = base_path.rstrip("/")
         self._server: Optional[ThreadingHTTPServer] = None
-        self._serve_lock = threading.Lock()
+        self._serve_lock = racecheck.make_lock("NotebookAgent._serve_lock")
         self._closed = False
         self._last_port = 0
 

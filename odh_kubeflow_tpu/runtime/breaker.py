@@ -17,11 +17,11 @@ Thread-safe; time injected for tests via the `clock` callable.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Dict, Optional
 
 from .metrics import breaker_trips_total
+from ..utils import racecheck
 
 
 class _Entry:
@@ -46,7 +46,7 @@ class CircuitBreaker:
         self.cooldown_s = cooldown_s
         self.max_cooldown_s = max_cooldown_s
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("CircuitBreaker._lock")
         self._entries: Dict[str, _Entry] = {}
         self.trips = 0  # observability mirror of breaker_trips_total
 

@@ -16,13 +16,13 @@ what the `api_reader` (uncached Client) is for.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, List, Optional, Tuple, Type
 
 from ..apimachinery import KubeObject, NotFoundError, Scheme, default_scheme
 from ..cluster.client import Client, T
 from ..cluster.store import Store
+from ..utils import racecheck
 from .informer import InformerRegistry
 
 
@@ -104,7 +104,7 @@ class TTLReadClient(Client):
         super().__init__(inner.store, inner.scheme)
         self._inner = inner
         self.ttl_s = ttl_s
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("TTLReadClient._lock")
         self._get_memo: Dict[Tuple, Tuple[float, Optional[dict]]] = {}
         self._list_memo: Dict[Tuple, Tuple[float, List[dict]]] = {}
 
@@ -152,7 +152,12 @@ class TTLReadClient(Client):
             raise
         with self._lock:
             self._prune(self._get_memo, now)
-            self._get_memo[key] = (now, obj.to_dict())
+            # memo entries are cache-owned the same way informer entries
+            # are: under RACECHECK they carry the write barrier so a caller
+            # mutating a decoded object's shared substructure raises
+            self._get_memo[key] = (
+                now, racecheck.guard_cache_object(obj.to_dict(), f"ttl-memo/{key}")
+            )
         return obj
 
     def list(
@@ -171,7 +176,13 @@ class TTLReadClient(Client):
         out = self._inner.list(cls, namespace=namespace, labels=labels)
         with self._lock:
             self._prune(self._list_memo, now)
-            self._list_memo[key] = (now, [o.to_dict() for o in out])
+            self._list_memo[key] = (
+                now,
+                [
+                    racecheck.guard_cache_object(o.to_dict(), f"ttl-memo/{key}")
+                    for o in out
+                ],
+            )
         return out
 
     # writes delegate to the fresh view: inner write + memo invalidation

@@ -20,6 +20,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..apimachinery import GoneError, Scheme, default_scheme
 from ..cluster.store import ADDED, DELETED, DROPPED, MODIFIED, Store, WatchEvent
+from ..utils import racecheck
 from .metrics import (
     informer_last_sync_timestamp_seconds,
     informer_synced,
@@ -46,7 +47,11 @@ class Informer:
         self.kind = kind
         self._cache: Dict[str, dict] = {}
         self._handlers: List[EventHandler] = []
-        self._lock = threading.RLock()
+        # RACECHECK=1 swaps in the instrumented lock (acquisition-order
+        # audit) and the cache write barrier; both are plain threading
+        # primitives / identity otherwise
+        self._lock = racecheck.make_rlock(f"Informer[{kind}]._lock")
+        self._racecheck = racecheck.enabled()
         self._watch = None
         self._thread: Optional[threading.Thread] = None
         self._stopped = threading.Event()
@@ -68,9 +73,16 @@ class Informer:
     def add_handler(self, handler: EventHandler) -> None:
         with self._lock:
             self._handlers.append(handler)
-            # late registrants see the current state as synthetic ADDs
+            # late registrants see the current state as synthetic ADDs.
+            # intentional lock-discipline exception: the replay must be
+            # atomic with registration — dispatching outside the lock opens
+            # a window where a concurrent _dispatch delivers an event for a
+            # key whose synthetic ADD has not fired yet (observed as a
+            # MODIFIED-before-ADDED inversion by the handler). Registration
+            # happens at controller setup, pre-traffic, so the hold is short
+            # and uncontended in practice.
             for obj in self._cache.values():
-                handler(ADDED, obj, None)
+                handler(ADDED, obj, None)  # lint: disable=lock-discipline
 
     def start(self) -> None:
         if self._thread is not None:
@@ -213,6 +225,14 @@ class Informer:
         rv = ev.object.get("metadata", {}).get("resourceVersion")
         if rv:
             self._rv = rv
+        if self._racecheck:
+            # the dict entering the cache (and every handler) becomes
+            # cache-owned NOW: wrap it in the write barrier so any in-place
+            # mutation downstream raises instead of corrupting the cache
+            ev = WatchEvent(
+                ev.type,
+                racecheck.guard_cache_object(ev.object, f"{self.kind}/{key}"),
+            )
         with self._lock:
             old = self._cache.get(key)
             if ev.type == DELETED:
@@ -240,7 +260,15 @@ class Informer:
         key = f"{namespace}/{name}" if namespace else name
         with self._lock:
             obj = self._cache.get(key)
-            return copy.deepcopy(obj) if obj else None
+            if obj is None:
+                return None
+            if self._racecheck:
+                # copy-on-read becomes a write barrier: the guarded object
+                # is safe to hand out (mutation raises), and skipping the
+                # copy is what lets RACECHECK runs catch callers that relied
+                # on the defensive deepcopy instead of making their own
+                return obj
+            return copy.deepcopy(obj)
 
     def list(self, namespace: Optional[str] = None, labels: Optional[dict] = None) -> List[dict]:
         """Snapshot of matching objects. Filters apply on the RAW cached
@@ -258,7 +286,7 @@ class Informer:
                     continue
                 if labels is not None and not match_labels(labels, meta.get("labels")):
                     continue
-                out.append(copy.deepcopy(o))
+                out.append(o if self._racecheck else copy.deepcopy(o))
             return out
 
 
@@ -267,7 +295,7 @@ class InformerRegistry:
         self.store = store
         self.scheme = scheme
         self._informers: Dict[Tuple[str, str], Informer] = {}
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("InformerRegistry._lock")
         self._started = False
 
     def informer_for(self, cls_or_gvk) -> Informer:

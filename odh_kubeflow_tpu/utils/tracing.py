@@ -30,6 +30,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+from . import racecheck
 
 # ---------------------------------------------------------------------------
 # W3C trace-context primitives
@@ -174,7 +175,7 @@ class TraceBuffer:
 
     def __init__(self, maxlen: int = 4096):
         self._spans: "collections.deque[Span]" = collections.deque(maxlen=maxlen)
-        self._lock = threading.Lock()
+        self._lock = racecheck.make_lock("TraceBuffer._lock")
 
     def append(self, span: Span) -> None:
         with self._lock:
@@ -368,7 +369,7 @@ def record_span(
 _open_roots: Dict[str, Span] = {}  # trace_id -> open root span
 _root_id_by_key: Dict[str, str] = {}  # dedup key (e.g. ns/name) -> trace_id
 _key_by_root_id: Dict[str, str] = {}  # reverse, for cleanup on finish/evict
-_roots_lock = threading.Lock()
+_roots_lock = racecheck.make_lock("tracing._roots_lock")
 # roots that never finish (CPU notebooks, deletes before ready) must not
 # grow without bound: oldest-first eviction past this cap
 _MAX_OPEN_ROOTS = 2048
