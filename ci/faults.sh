@@ -35,4 +35,18 @@ echo "=== faults lane: RACECHECK=1 iteration ==="
 RACECHECK=1 python -m pytest tests/test_faults.py -q -m "faults and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck) ==="
+# slice chaos lane (ISSUE 4): preemption / chip / ICI faults through the
+# repair path — the seeded slice "bad day" asserts the acceptance invariant
+# (every faulted notebook returns to Ready with a slice.repair trace, or
+# ends in an explicit RepairFailed event; zero silently stuck), rerun under
+# the same stress loop + one RACECHECK=1 iteration
+for i in $(seq 1 "$REPEAT"); do
+    echo "=== slice chaos lane: iteration $i/$REPEAT ==="
+    python -m pytest tests/test_slice_repair.py -q -m "slice_repair and not slow" \
+        -p no:cacheprovider -p no:randomly "$@"
+done
+echo "=== slice chaos lane: RACECHECK=1 iteration ==="
+RACECHECK=1 python -m pytest tests/test_slice_repair.py -q -m "slice_repair and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck, incl. slice chaos) ==="

@@ -26,6 +26,7 @@ from .meta import (
     now_rfc3339,
     parse_time,
     rfc3339,
+    rfc3339_precise,
     sanitize_name,
     set_condition,
 )

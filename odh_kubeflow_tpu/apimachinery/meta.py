@@ -29,6 +29,19 @@ def now_rfc3339() -> str:
     return rfc3339(_time.time())
 
 
+def rfc3339_precise(ts: float) -> str:
+    """Unix timestamp -> RFC3339 with microseconds. For MACHINE deadlines
+    (maintenance windows, checkpoint-before-evict, repair anchors): the
+    k8s-style whole-second form FLOORS, so a sub-second grace window
+    serialized through rfc3339() can collapse to zero or negative and a
+    drain fires before the checkpoint opportunity it was announcing."""
+    return (
+        datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
 def parse_time(s: str) -> datetime.datetime:
     return datetime.datetime.fromisoformat(s.replace("Z", "+00:00"))
 

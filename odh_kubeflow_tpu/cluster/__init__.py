@@ -11,8 +11,15 @@ from .store import (
     register_storage_alias,
 )
 from .apiserver import ApiServer, parse_label_selector
-from .faults import FaultInjector, FaultRule, seeded_bad_day
-from .kubelet import Behavior, Kubelet, PodDecision
+from .faults import (
+    MAINTENANCE_WINDOW_ANNOTATION,
+    PREEMPTION_TAINT_KEY,
+    FaultInjector,
+    FaultRule,
+    seeded_bad_day,
+    seeded_slice_bad_day,
+)
+from .kubelet import Behavior, Kubelet, NodeLifecycle, PodDecision
 from .remote import RemoteStore, RemoteWatch
 from .webhook_dispatch import WebhookDispatcher
 from .scheduler import Scheduler

@@ -29,6 +29,15 @@ Fault sites (the `site` strings components consult):
 - ``kubelet.pod``         each kubelet reconcile (ctx: namespace, name, obj) —
   action rules here ("crash") are *decided*, not raised
 - ``probe.http``          the sim cluster-DNS HTTP transport (ctx: host, url)
+
+Slice-level faults (the accelerator layer, ISSUE 4): host preemption is an
+*active operation* like drop_watches — `preempt_host` taints the node with a
+cluster-autoscaler-style deletion-candidate taint plus a maintenance-window
+notice, and the sim's node lifecycle (cluster/kubelet.py NodeLifecycle)
+drains it when the grace window lapses. Chip loss / ICI degradation are
+scripted at the in-pod probe agent (its monitor REPORTS the fault; the probe
+controller aggregates it into the `TPUHealthy` condition). The combined
+seeded schedule is `seeded_slice_bad_day`.
 """
 from __future__ import annotations
 
@@ -38,6 +47,13 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..apimachinery import ConflictError, GoneError, TooManyRequestsError
 from ..utils import racecheck
+
+# Host preemption surfaces exactly the way GKE announces it: a soft
+# cluster-autoscaler-style taint plus a maintenance-window notice annotation
+# carrying the drain deadline. These are CLUSTER-side contracts (node keys),
+# not operator annotation keys — their home is the fault substrate.
+PREEMPTION_TAINT_KEY = "DeletionCandidateOfClusterAutoscaler"
+MAINTENANCE_WINDOW_ANNOTATION = "cloud.google.com/active-node-maintenance"
 
 
 @dataclass
@@ -93,6 +109,7 @@ class FaultInjector:
         self._rules: List[FaultRule] = []
         self.rng = random.Random(seed)
         self._stores: List[Any] = []  # bound Stores, for sever_watches
+        self._cluster: Any = None  # bound SimCluster, for preempt_host
 
     # -- rule management --
 
@@ -158,6 +175,32 @@ class FaultInjector:
         for store in stores:
             severed += store.sever_watches(api_version=api_version, kind=kind)
         return severed
+
+    def bind_cluster(self, cluster: Any) -> None:
+        """Register the SimCluster so host-level faults can be enacted
+        through one injector handle (SimCluster binds itself at __init__)."""
+        with self._lock:
+            self._cluster = cluster
+
+    def preempt_host(self, node_name: str, grace_s: float = 0.5) -> None:
+        """Preempt a TPU host: the node gets the deletion-candidate taint +
+        a maintenance-window notice whose deadline is now+grace_s; the node
+        lifecycle drains it when the window lapses. The grace window is the
+        slice-repair controller's checkpoint-before-evict opportunity."""
+        with self._lock:
+            cluster = self._cluster
+        if cluster is None:
+            raise RuntimeError("no SimCluster bound (FaultInjector.bind_cluster)")
+        cluster.preempt_node(node_name, grace_s=grace_s)
+
+    def restore_host(self, node_name: str) -> None:
+        """End a host's maintenance: taint + notice removed, capacity returns
+        (the scheduler's capacity-freed watch re-attempts pending gangs)."""
+        with self._lock:
+            cluster = self._cluster
+        if cluster is None:
+            raise RuntimeError("no SimCluster bound (FaultInjector.bind_cluster)")
+        cluster.restore_node(node_name)
 
     # -- scripted fault constructors --
 
@@ -249,3 +292,52 @@ def seeded_bad_day(injector: FaultInjector, seed: int,
         injector.partition_probe(times=rng.randint(2, 5)),
     ]
     return rules
+
+
+def seeded_slice_bad_day(
+    cluster: Any,
+    seed: int,
+    pod_nodes: Dict[str, str],
+    agents: Optional[Dict[str, Any]] = None,
+    grace_s: float = 0.4,
+    control_plane: bool = True,
+) -> Dict[str, List[str]]:
+    """One deterministic accelerator-layer bad day on top of the control-plane
+    schedule: every victim choice is drawn from random.Random(seed).
+
+    `pod_nodes` maps pod name -> node name for the candidate victims (the
+    caller reads placements after bring-up). Enacts, per seeded draw:
+    - host preemption (taint + maintenance notice; the node lifecycle drains
+      after `grace_s`) on 1..len/2 distinct hosts,
+    - chip loss (agent's monitor drops half its visible chips) or ICI
+      degradation on 0..2 of the remaining pods, when `agents` is given.
+
+    Returns the enacted plan {"preempted": [nodes], "chip_loss": [pods],
+    "ici": [pods]} so the soak can heal preemptions and assert outcomes."""
+    rng = random.Random(seed)
+    # draw the control-plane seed FIRST so the fault set is a pure function
+    # of `seed`, but install those rules LAST: the slice-fault enactment
+    # below goes through the same store, and a 429 rule swallowing the
+    # scenario driver's own taint write would silently shrink the bad day
+    cp_seed = rng.randrange(2**31) if control_plane else None
+    plan: Dict[str, List[str]] = {"preempted": [], "chip_loss": [], "ici": []}
+    pods = sorted(pod_nodes)
+    if pods:
+        n_preempt = rng.randint(1, max(1, len(pods) // 2))
+        victims = rng.sample(pods, n_preempt)
+        for pod in victims:
+            cluster.preempt_node(pod_nodes[pod], grace_s=grace_s)
+            plan["preempted"].append(pod_nodes[pod])
+        if agents is not None:
+            survivors = [p for p in pods if p not in victims and p in agents]
+            for pod in rng.sample(survivors, min(len(survivors), rng.randint(0, 2))):
+                monitor = agents[pod].monitor
+                if rng.random() < 0.5 and getattr(monitor, "chips", 0) > 1:
+                    monitor.chips = monitor.chips // 2
+                    plan["chip_loss"].append(pod)
+                else:
+                    monitor.ici_fault = True
+                    plan["ici"].append(pod)
+    if cp_seed is not None:
+        seeded_bad_day(cluster.faults, seed=cp_seed)
+    return plan

@@ -9,14 +9,25 @@ an actual socket."""
 from __future__ import annotations
 
 import itertools
+import logging
+import time
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Tuple
 
-from ..api.core import ContainerState, ContainerStatus, Pod
-from ..apimachinery import Condition, ConflictError, NotFoundError, now_rfc3339
+from ..api.core import ContainerState, ContainerStatus, Node, Pod
+from ..apimachinery import (
+    Condition,
+    ConflictError,
+    NotFoundError,
+    now_rfc3339,
+    parse_time,
+)
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..utils import racecheck
+from .faults import MAINTENANCE_WINDOW_ANNOTATION, PREEMPTION_TAINT_KEY
+
+log = logging.getLogger(__name__)
 
 _ip_seq = itertools.count(2)
 
@@ -284,3 +295,76 @@ class Kubelet:
             self.client.update_status(pod)
         except (ConflictError, NotFoundError):
             pass  # re-reconciled via watch anyway
+
+
+class NodeLifecycle:
+    """Node-agent half of host preemption (GKE maintenance semantics).
+
+    A node carrying the deletion-candidate taint + maintenance-window notice
+    (cluster/faults.py: preempt_host / SimCluster.preempt_node) keeps its
+    pods alive through the grace window — that window is the slice-repair
+    controller's checkpoint-before-evict opportunity — then drains: every
+    pod still bound to the host is deleted and the node goes Ready=False
+    until restored. The taint alone already keeps NEW pods off the host
+    (scheduler taint semantics), so a drained gang can never be re-placed
+    onto the dying node."""
+
+    def __init__(self, manager: Manager):
+        self.manager = manager
+        self.client = manager.client
+
+    def setup(self) -> None:
+        self.manager.builder("node-lifecycle").for_(Node).complete(self.reconcile)
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        try:
+            node = self.client.get(Node, "", req.name)
+        except NotFoundError:
+            return None
+        if not any(
+            t.get("key") == PREEMPTION_TAINT_KEY
+            for t in node.spec.get("taints", [])
+        ):
+            return None
+        deadline = 0.0
+        notice = node.metadata.annotations.get(MAINTENANCE_WINDOW_ANNOTATION, "")
+        if notice:
+            try:
+                deadline = parse_time(notice).timestamp()
+            except ValueError:
+                deadline = 0.0  # malformed notice: drain immediately
+        remaining = deadline - time.time()
+        if remaining > 0:
+            return Result(requeue_after=max(0.01, remaining))
+
+        # grace lapsed: drain. The host is going away — kill its pods (their
+        # owners recreate them elsewhere) and mark the node NotReady.
+        for pod in self.client.list(Pod):
+            if (
+                pod.spec.node_name == node.metadata.name
+                and not pod.metadata.deletion_timestamp
+            ):
+                try:
+                    self.client.delete(
+                        Pod, pod.metadata.namespace, pod.metadata.name
+                    )
+                except NotFoundError:
+                    pass  # racing deletion; drained either way
+        if not any(
+            c.type == "Ready" and c.status == "False"
+            for c in node.status.conditions
+        ):
+            node.status.conditions = [
+                Condition(
+                    type="Ready",
+                    status="False",
+                    reason="TerminationDueToMaintenance",
+                    message="host preempted (maintenance window lapsed)",
+                    last_transition_time=now_rfc3339(),
+                )
+            ]
+            try:
+                self.client.update_status(node)
+            except (ConflictError, NotFoundError):
+                log.debug("node %s drain status write raced; re-reconciled", req.name)
+        return None

@@ -9,7 +9,12 @@ every multi-host pool IS one ICI slice. Placement rules:
   SAME node pool (= same ICI slice), all-or-nothing — if the pool can't hold
   every replica, nothing schedules and an Unschedulable event is emitted
   (SURVEY §7 hard part (d): scheduling atomicity for multi-host slices),
-- CPU/memory capacity accounting for non-TPU pods.
+- CPU/memory capacity accounting for non-TPU pods,
+- NotReady nodes (drained/preempted hosts) take no new pods,
+- unschedulable pods requeue with exponential backoff AND are re-attempted
+  the moment capacity frees (node added/restored, a scheduled pod deleted) —
+  a waiting gang must not sit out a full backoff window after the slice it
+  needs opens up.
 """
 from __future__ import annotations
 
@@ -27,6 +32,7 @@ from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import GKE_NODEPOOL_LABEL, TPU_RESOURCE
 from ..utils import parse_quantity
+from .store import DELETED
 
 def pod_tpu_request(pod: Pod) -> int:
     total = 0
@@ -47,14 +53,40 @@ def pod_resource_request(pod: Pod, resource: str) -> float:
 
 
 class Scheduler:
+    # unschedulable requeue: exponential from base to cap. The cap stays
+    # coarse because the capacity-freed watches below are the fast path —
+    # backoff is only the safety net for capacity changes with no event.
+    backoff_base_s = 0.25
+    backoff_max_s = 5.0
+
     def __init__(self, manager: Manager):
         self.manager = manager
         self.client = manager.client
+        # pod key -> consecutive unschedulable attempts (single scheduler
+        # worker: no lock needed; pruned on schedule/delete)
+        self._unsched_attempts: Dict[str, int] = {}
 
     def setup(self) -> None:
+        def pending_pods(_obj: dict) -> List[tuple]:
+            """Capacity-freed mapper: re-enqueue every unscheduled pod."""
+            return [
+                (p.metadata.namespace, p.metadata.name)
+                for p in self.client.list(Pod)
+                if not p.spec.node_name and not p.metadata.deletion_timestamp
+            ]
+
+        def frees_capacity(ev: str, obj: dict, _old: Optional[dict]) -> bool:
+            # a scheduled pod leaving the cluster returns its node's capacity
+            return ev == DELETED and bool(obj.get("spec", {}).get("nodeName"))
+
         (
             self.manager.builder("scheduler")
             .for_(Pod, predicate=lambda ev, obj, old: not obj.get("spec", {}).get("nodeName"))
+            # nodes appearing/changing (new pool, maintenance ending) and
+            # scheduled pods departing both free capacity: re-attempt every
+            # pending pod immediately instead of waiting out its backoff
+            .watches(Node, pending_pods)
+            .watches(Pod, pending_pods, predicate=frees_capacity)
             .complete(self.reconcile)
         )
 
@@ -89,7 +121,17 @@ class Scheduler:
                 return False
         return True
 
+    def _node_healthy(self, node: Node) -> bool:
+        """Ready=False nodes (drained/preempted hosts) take no new pods; a
+        node with no Ready condition at all is healthy (sim default)."""
+        return not any(
+            c.type == "Ready" and c.status == "False"
+            for c in node.status.conditions
+        )
+
     def _selector_matches(self, pod: Pod, node: Node) -> bool:
+        if not self._node_healthy(node):
+            return False
         for k, v in pod.spec.node_selector.items():
             if node.metadata.labels.get(k) != v:
                 return False
@@ -126,8 +168,10 @@ class Scheduler:
         try:
             pod = self.client.get(Pod, req.namespace, req.name)
         except NotFoundError:
+            self._unsched_attempts.pop(req.key, None)
             return None
         if pod.spec.node_name or pod.metadata.deletion_timestamp:
+            self._unsched_attempts.pop(req.key, None)
             return None
 
         nodes = self.client.list(Node)
@@ -171,8 +215,17 @@ class Scheduler:
 
         if chosen is None:
             self._emit_unschedulable(pod, tpu_chips)
-            return Result(requeue_after=0.5)
+            # exponential backoff; the capacity-freed watches (setup) are the
+            # fast path back in, so the poll only backstops eventless changes
+            attempts = self._unsched_attempts.get(req.key, 0)
+            self._unsched_attempts[req.key] = attempts + 1
+            return Result(
+                requeue_after=min(
+                    self.backoff_max_s, self.backoff_base_s * (2 ** attempts)
+                )
+            )
 
+        self._unsched_attempts.pop(req.key, None)
         pod.spec.node_name = chosen.metadata.name
         self.client.update(pod)
         return None

@@ -7,11 +7,17 @@ servers. Product controllers run on a SEPARATE manager, exactly like the
 reference's two-process split against one API server."""
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 from urllib.parse import urlparse
 
 from ..api.core import Node
-from ..apimachinery import AlreadyExistsError
+from ..apimachinery import (
+    AlreadyExistsError,
+    Condition,
+    now_rfc3339,
+    rfc3339_precise,
+)
 from ..runtime.manager import Manager
 from ..tpu import (
     GKE_NODEPOOL_LABEL,
@@ -21,8 +27,12 @@ from ..tpu import (
     plan_slice,
 )
 from .client import Client
-from .faults import FaultInjector
-from .kubelet import Behavior, Kubelet, PodDecision
+from .faults import (
+    MAINTENANCE_WINDOW_ANNOTATION,
+    PREEMPTION_TAINT_KEY,
+    FaultInjector,
+)
+from .kubelet import Behavior, Kubelet, NodeLifecycle, PodDecision
 from .scheduler import Scheduler
 from .statefulset import StatefulSetController
 from .store import Store
@@ -41,9 +51,12 @@ class SimCluster:
         self.scheduler = Scheduler(self.system)
         self.sts_controller = StatefulSetController(self.system)
         self.kubelet = Kubelet(self.system)
+        self.node_lifecycle = NodeLifecycle(self.system)
         self.scheduler.setup()
         self.sts_controller.setup()
         self.kubelet.setup()
+        self.node_lifecycle.setup()
+        self.faults.bind_cluster(self)
         self._started = False
 
     # -- lifecycle --
@@ -118,6 +131,88 @@ class SimCluster:
     # -- pod behaviors (startup latency, failures, real servers) --
     def add_pod_behavior(self, behavior: Behavior) -> None:
         self.kubelet.add_behavior(behavior)
+
+    # -- host preemption / maintenance (the slice-level fault substrate) --
+    @staticmethod
+    def _retry_persistent(fn, attempts: int = 40) -> None:
+        """Scenario-driver writes (taint/restore) must land even while the
+        cluster's own injector is throwing 409/429 at everything — the fault
+        being scripted must not eat the script."""
+        from ..apimachinery import ConflictError, TooManyRequestsError
+
+        for i in range(attempts):
+            try:
+                fn()
+                return
+            except (ConflictError, TooManyRequestsError):
+                if i == attempts - 1:
+                    raise
+                time.sleep(0.02)
+
+    def preempt_node(self, name: str, grace_s: float = 0.5) -> None:
+        """Announce a host preemption the way GKE does: deletion-candidate
+        taint + maintenance-window notice with the drain deadline. Pods stay
+        up through the grace window (checkpoint-before-evict opportunity);
+        NodeLifecycle drains the host when it lapses."""
+
+        def attempt():
+            node = self.client.get(Node, "", name)
+            taints = [
+                t
+                for t in node.spec.get("taints", [])
+                if t.get("key") != PREEMPTION_TAINT_KEY
+            ]
+            taints.append(
+                {
+                    "key": PREEMPTION_TAINT_KEY,
+                    "value": "preempt",
+                    "effect": "NoSchedule",
+                }
+            )
+            node.spec["taints"] = taints
+            # precise form: whole-second rfc3339() FLOORS, collapsing a
+            # sub-second grace window to zero — the drain would beat the
+            # checkpoint opportunity the notice exists to announce
+            node.metadata.annotations[MAINTENANCE_WINDOW_ANNOTATION] = (
+                rfc3339_precise(time.time() + grace_s)
+            )
+            self.client.update(node)
+
+        self._retry_persistent(attempt)
+
+    def restore_node(self, name: str) -> None:
+        """Maintenance over: taint + notice removed, node Ready again —
+        capacity returns and the scheduler's capacity-freed watch re-attempts
+        any pending gang."""
+
+        def attempt():
+            node = self.client.get(Node, "", name)
+            node.spec["taints"] = [
+                t
+                for t in node.spec.get("taints", [])
+                if t.get("key") != PREEMPTION_TAINT_KEY
+            ]
+            node.metadata.annotations.pop(MAINTENANCE_WINDOW_ANNOTATION, None)
+            self.client.update(node)
+
+        def heal_status():
+            node = self.client.get(Node, "", name)
+            if any(
+                c.type == "Ready" and c.status == "False"
+                for c in node.status.conditions
+            ):
+                node.status.conditions = [
+                    Condition(
+                        type="Ready",
+                        status="True",
+                        reason="MaintenanceComplete",
+                        last_transition_time=now_rfc3339(),
+                    )
+                ]
+                self.client.update_status(node)
+
+        self._retry_persistent(attempt)
+        self._retry_persistent(heal_status)
 
     # -- cluster DNS --
     def resolve(self, host: str) -> Optional[Tuple[str, int]]:

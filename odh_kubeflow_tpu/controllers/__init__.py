@@ -4,5 +4,6 @@ from .metrics import NotebookMetrics
 from .notebook import EventMirrorController, NotebookReconciler, hosts_service_name
 from .culling import CullingReconciler
 from .probe_status import ProbeStatusController
+from .slice_repair import SliceRepairController
 from .webhook import NotebookWebhook
 from .extension import TPUWorkbenchReconciler

@@ -43,6 +43,28 @@ RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
 # jax.devices()-ready path (reconciler, kubelet, probe gate) joins ONE trace
 from ..utils.tracing import TRACEPARENT_ANNOTATION  # noqa: E402,F401  (canonical home)
 
+# -- slice repair (controllers/slice_repair.py) --
+# The durable repair state machine lives in annotations (SURVEY §5: the API
+# server is the database), mirrored into conditions for humans:
+#   Ready -> Degraded (fault detected; checkpoint-before-evict window)
+#         -> Repairing (gang evicted; all-or-nothing re-placement)
+#         -> Ready (repaired)  |  RepairFailed (attempts exhausted; terminal)
+TPU_REPAIR_STATE_ANNOTATION = "notebooks.tpu.kubeflow.org/repair-state"
+TPU_REPAIR_STARTED_ANNOTATION = "notebooks.tpu.kubeflow.org/repair-started"
+TPU_REPAIR_ATTEMPTS_ANNOTATION = "notebooks.tpu.kubeflow.org/repair-attempts"
+TPU_REPAIR_CAUSE_ANNOTATION = "notebooks.tpu.kubeflow.org/repair-cause"
+# checkpoint-before-evict contract: the repair controller stamps the window
+# deadline here BEFORE evicting the gang; the in-pod agent's /tpu/checkpoint
+# hook (probe/agent.py -> models/checkpoint.py) is driven inside that window,
+# and the last acked step is recorded for the resumed workload to restore
+TPU_CHECKPOINT_REQUEST_ANNOTATION = "notebooks.tpu.kubeflow.org/checkpoint-before-evict"
+TPU_CHECKPOINT_SAVED_ANNOTATION = "notebooks.tpu.kubeflow.org/checkpoint-saved"
+
+# condition types on NotebookStatus (owned by probe_status / slice_repair;
+# the core reconciler's pod-condition mirror preserves these)
+TPU_HEALTHY_CONDITION = "TPUHealthy"
+TPU_DEGRADED_CONDITION = "Degraded"
+
 # -- TPU-native additions --
 TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"
 # stamped on Events the mirror controller creates, and checked on ingest, so

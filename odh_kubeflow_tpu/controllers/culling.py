@@ -31,6 +31,7 @@ from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import plan_slice
 from . import constants as C
+from .conditions import condition_is
 from .config import Config
 from .metrics import NotebookMetrics
 from .notebook import per_ordinal_probe_urls, statefulset_name
@@ -192,6 +193,19 @@ class CullingReconciler:
         if C.STOP_ANNOTATION in annotations:
             self._remove_activity_annotations(nb)
             return None
+
+        # mid-repair (Degraded or the repair-state machine active): the
+        # notebook is DOWN, not idle — its pods are evicted/rescheduling and
+        # every probe would fail. Suspend the idleness clock entirely: no
+        # probe, no cull, no annotation advance. The slice-repair controller
+        # resets last-activity at repair completion, so recovery time never
+        # counts as idleness (a preempted notebook must not be culled for
+        # "idling" during its own repair).
+        if (
+            C.TPU_REPAIR_STATE_ANNOTATION in annotations
+            or condition_is(nb, C.TPU_DEGRADED_CONDITION, "True")
+        ):
+            return Result(requeue_after=period_s)
 
         # pod 0 gone, going, or not yet Ready: nothing to probe (reference
         # :120-135, strengthened). Idleness is only measurable on a READY

@@ -47,6 +47,7 @@ from ..runtime.manager import Manager
 from ..tpu import SliceShape, TPU_RESOURCE, plan_slice, tpu_env, ordinal_env
 from ..utils.tracing import reconcile_tracer
 from . import constants as C
+from .conditions import REPAIR_OWNED_CONDITIONS
 from .config import Config
 from .metrics import NotebookMetrics
 
@@ -460,6 +461,14 @@ class NotebookReconciler:
             None,
         )
         if pod0 is not None:
+            # the pod-condition mirror must not stomp the repair stack's
+            # conditions (TPUHealthy/Degraded — probe_status + slice_repair
+            # own those; see controllers/conditions.py)
+            preserved = [
+                c
+                for c in status.conditions
+                if c.type in REPAIR_OWNED_CONDITIONS
+            ]
             status.conditions = [
                 Condition(
                     type=c.type,
@@ -470,7 +479,7 @@ class NotebookReconciler:
                     last_transition_time=c.last_transition_time,
                 )
                 for c in pod0.status.conditions
-            ]
+            ] + preserved
             primary = next(
                 (
                     cs

@@ -35,6 +35,7 @@ from ..runtime.manager import Manager
 from ..tpu import plan_slice
 from ..utils import tracing
 from . import constants as C
+from .conditions import get_condition, write_condition
 from .config import Config
 from .culling import HTTPGet, _default_http_get
 from .metrics import NotebookMetrics
@@ -121,7 +122,16 @@ class ProbeStatusController:
             return None  # CPU notebook: no device gate
         if C.STOP_ANNOTATION in nb.metadata.annotations:
             # stopped slices have no devices; clear the gate but keep
-            # first_ready_time (it anchors the FIRST bring-up latency)
+            # first_ready_time (it anchors the FIRST bring-up latency). The
+            # health verdict goes Unknown — a stale False must not read as a
+            # live fault when the notebook is unstopped (the slice-repair
+            # controller only acts on an affirmative False)
+            if get_condition(nb, C.TPU_HEALTHY_CONDITION) is not None:
+                write_condition(
+                    self.client, self.api_reader, nb,
+                    C.TPU_HEALTHY_CONDITION, "Unknown", "Stopped",
+                    "notebook stopped; no devices to probe",
+                )
             self._write(nb, chips_visible=0, mesh_ready=False, newly_ready=False)
             return None
 
@@ -176,6 +186,25 @@ class ProbeStatusController:
             and nb.status.ready_replicas >= shape.hosts
         )
 
+        # device-health aggregation -> the TPUHealthy condition (the slice-
+        # repair controller's detection signal). Judged only once the slice
+        # has been ready at least once (or is ready right now): during FIRST
+        # bring-up an unreachable agent is normal, not a fault — the mesh
+        # gate owns bring-up, TPUHealthy owns degradation-after-ready.
+        if mesh_ready or (nb.status.tpu and nb.status.tpu.first_ready_time):
+            healthy, reason, message = self._device_health(
+                reports, shape, ready_pods
+            )
+            write_condition(
+                self.client,
+                self.api_reader,
+                nb,
+                C.TPU_HEALTHY_CONDITION,
+                "True" if healthy else "False",
+                reason,
+                message,
+            )
+
         newly_ready = mesh_ready and not (
             nb.status.tpu and nb.status.tpu.first_ready_time
         )
@@ -197,6 +226,51 @@ class ProbeStatusController:
         # keep polling until the mesh gate is green; afterwards stay on a slow
         # heartbeat so chip loss (e.g. a host losing devices) is re-detected
         return Result(requeue_after=period_s if not mesh_ready else period_s * 6)
+
+    # ---------- device health (the TPUHealthy verdict) ----------
+
+    @staticmethod
+    def _device_health(
+        reports: List[Optional[dict]], shape, ready_pods: int
+    ) -> Tuple[bool, str, str]:
+        """(healthy, reason, message) from one probe sweep. Precedence:
+        unreachable hosts (preempted/crashed — the most urgent) > degraded
+        ICI links > missing chips; healthy only when every host reported and
+        every device checked out."""
+        unreachable = sum(1 for r in reports if r is None)
+        if unreachable or ready_pods < shape.hosts or len(reports) < shape.hosts:
+            down = max(unreachable, shape.hosts - ready_pods)
+            return (
+                False,
+                "HostUnreachable",
+                f"{down}/{shape.hosts} hosts unreachable or not ready",
+            )
+        ici_hosts = [i for i, r in enumerate(reports) if r.get("ici_degraded")]
+        if ici_hosts:
+            return (
+                False,
+                "ICIDegraded",
+                f"hosts {ici_hosts} report degraded ICI links",
+            )
+        missing = 0
+        dead: List[str] = []  # "ordinal/device" ids from per-device health
+        for i, r in enumerate(reports):
+            failed = r.get("chips_failed")
+            if failed is None:
+                failed = max(
+                    0,
+                    int(r.get("chips_expected", 0)) - int(r.get("chips_visible", 0)),
+                )
+            missing += int(failed)
+            for d in r.get("device_health") or []:
+                if not d.get("healthy", True):
+                    dead.append(f"{i}/{d.get('id')}")
+        if missing:
+            message = f"{missing} expected chips not visible"
+            if dead:
+                message += f" (dead devices host/id: {', '.join(dead[:8])})"
+            return False, "ChipFailure", message
+        return True, "AllDevicesHealthy", ""
 
     # ---------- readiness trace (terminal spans + root closure) ----------
 

@@ -18,6 +18,7 @@ from .controllers import (
     NotebookReconciler,
     NotebookWebhook,
     ProbeStatusController,
+    SliceRepairController,
     TPUWorkbenchReconciler,
 )
 from .controllers.metrics import NotebookMetrics
@@ -55,6 +56,7 @@ def build_manager(
     TPUWorkbenchReconciler(mgr, config).setup()
     ProbeStatusController(mgr, config, http_get=http_get, metrics=metrics).setup()
     CullingReconciler(mgr, config, http_get=http_get, metrics=metrics).setup()
+    SliceRepairController(mgr, config, http_get=http_get).setup()
     return mgr
 
 

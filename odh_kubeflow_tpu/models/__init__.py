@@ -4,7 +4,12 @@ The reference ships no model code (its payload is the user's image); the
 TPU-native build ships a reference workload so a provisioned slice can be
 exercised, benchmarked, and utilization-probed out of the box.
 """
-from .checkpoint import latest_step, restore_train_state, save_train_state
+from .checkpoint import (
+    latest_step,
+    make_checkpoint_hook,
+    restore_train_state,
+    save_train_state,
+)
 from .decode import KVCache, decode_step, generate, init_cache, prefill
 from .moe import MoEConfig, moe_ffn, route_indices, route_topk
 from .transformer import (
@@ -31,6 +36,7 @@ __all__ = [
     "init_cache",
     "prefill",
     "latest_step",
+    "make_checkpoint_hook",
     "restore_train_state",
     "save_train_state",
     "TransformerConfig",

@@ -46,6 +46,27 @@ def latest_step(directory: str) -> Optional[int]:
         mngr.close()
 
 
+def make_checkpoint_hook(
+    directory: str, state_provider: Any, max_to_keep: int = 3
+):
+    """Checkpoint hook for the in-pod probe agent (NotebookAgent's
+    `checkpoint_hook`): during a checkpoint-before-evict window the
+    slice-repair controller GETs /tpu/checkpoint on every host, and this
+    saves the live train state so the rescheduled gang resumes exactly.
+
+    `state_provider` returns (step, state_pytree) for the current run — the
+    training loop typically closes over its latest step. Saves are per-shard
+    (each host writes only what it owns), so driving the hook on every
+    ordinal of a multi-host slice is the correct, complete save."""
+
+    def hook() -> dict:
+        step, state = state_provider()
+        save_train_state(directory, int(step), state, max_to_keep=max_to_keep)
+        return {"step": int(step)}
+
+    return hook
+
+
 def restore_train_state(
     directory: str, like: Any, step: Optional[int] = None, mesh=None
 ) -> Any:

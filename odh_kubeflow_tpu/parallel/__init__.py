@@ -9,7 +9,11 @@ webhook injects (tpu/env.py) turns into a live ICI mesh with one call:
     initialize_from_env()                       # multi-host bring-up
     mesh = MeshPlan.auto(len(jax.devices())).build()
 """
-from .distributed import initialize_from_env, slice_mesh_axes
+from .distributed import (
+    initialize_from_env,
+    reinitialize_after_repair,
+    slice_mesh_axes,
+)
 from .interleaved_1f1b import (
     build_schedule as build_interleaved_1f1b_schedule,
     pipeline_value_and_grad_interleaved_1f1b,
@@ -33,6 +37,7 @@ __all__ = [
     "MeshPlan",
     "batch_spec",
     "initialize_from_env",
+    "reinitialize_after_repair",
     "logical_to_spec",
     "shard_batch",
     "slice_mesh_axes",

@@ -55,6 +55,36 @@ def initialize_from_env(timeout_s: Optional[int] = None) -> Tuple[int, int]:
     return process_id, num_processes
 
 
+def reinitialize_after_repair(timeout_s: Optional[int] = None) -> Tuple[int, int]:
+    """Re-run the multi-host bring-up after a slice repair.
+
+    When the slice-repair controller evicts and reschedules a gang
+    (controllers/slice_repair.py), every worker process restarts on a
+    possibly different host — ordinarily a fresh process just calls
+    initialize_from_env(). This entrypoint also covers the surviving-process
+    case (a host that was NOT replaced but whose peers were): an initialized
+    jax.distributed client is torn down first, then bring-up re-reads the
+    env — the coordinator address is the ordinal-0 pod's stable headless-
+    Service DNS, so it is valid again the moment the new gang is up.
+
+    Pairs with models/checkpoint.py: reinitialize, then restore_train_state
+    onto the new mesh, and the run continues from the last checkpoint the
+    checkpoint-before-evict window saved."""
+    import jax
+
+    # older jax (0.4.x) has no is_initialized; there a process that never
+    # called initialize (single host) simply has nothing to tear down
+    is_initialized = getattr(jax.distributed, "is_initialized", None)
+    if is_initialized is not None and is_initialized():
+        try:
+            jax.distributed.shutdown()
+        except RuntimeError:
+            # a dead coordinator can make shutdown raise after the fault
+            # that triggered the repair; bring-up below is what matters
+            pass
+    return initialize_from_env(timeout_s=timeout_s)
+
+
 def slice_mesh_axes(shape: SliceShape, want_sp: int = 1, want_tp: int = 0):
     """MeshPlan for a whole slice: tp defaults to the chips of one host (tp
     collectives stay on-board), sp as requested for long-context, fsdp gets

@@ -60,6 +60,25 @@ class TPUMonitor:
         from the first read (sim, scraped runtime metrics)."""
         return False
 
+    def device_health(self) -> List[Dict[str, Any]]:
+        """Per-local-device health reports, derived from chip visibility by
+        default: an expected-but-invisible chip is a dead chip as far as the
+        mesh is concerned (jax.local_devices() simply stops listing it).
+        Monitors with richer introspection can override."""
+        visible = self.chips_visible()
+        expected = self.chips_expected()
+        return [
+            {"id": i, "healthy": i < visible}
+            for i in range(max(visible, expected))
+        ]
+
+    def ici_degraded(self) -> bool:
+        """True when the host observes degraded ICI links. libtpu exposes no
+        stable public link-health series, so the real monitor keeps the
+        default (chip visibility is the load-bearing signal); the sim monitor
+        scripts it so the controller's ICI repair path is testable."""
+        return False
+
 
 class JaxTPUMonitor(TPUMonitor):
     """Real implementation: introspects the local JAX/TPU runtime.
@@ -308,13 +327,15 @@ def parse_duty_cycle_metrics(text: str) -> Optional[float]:
 
 @dataclass
 class SimTPUMonitor(TPUMonitor):
-    """Scriptable monitor for tests/benchmarks."""
+    """Scriptable monitor for tests/benchmarks. Chip failure is scripted by
+    dropping `chips` below `expected`; ICI degradation via `ici_fault`."""
 
     chips: int = 4
     expected: int = 4
     pid: int = 0
     duty: float = 0.0
     last_busy_ts: float = 0.0
+    ici_fault: bool = False
 
     def chips_visible(self) -> int:
         return self.chips
@@ -330,6 +351,9 @@ class SimTPUMonitor(TPUMonitor):
 
     def last_busy(self) -> float:
         return self.last_busy_ts
+
+    def ici_degraded(self) -> bool:
+        return self.ici_fault
 
 
 @dataclass
@@ -360,10 +384,17 @@ class NotebookAgent:
         monitor: Optional[TPUMonitor] = None,
         kernels: Optional[KernelState] = None,
         base_path: str = "",
+        checkpoint_hook: Optional[Any] = None,
     ):
         self.monitor = monitor or JaxTPUMonitor()
         self.kernels = kernels or KernelState()
         self.base_path = base_path.rstrip("/")
+        # checkpoint-before-evict contract: the slice-repair controller GETs
+        # /tpu/checkpoint during the maintenance grace window; the hook saves
+        # the live train state (models/checkpoint.py make_checkpoint_hook)
+        # and returns {"step": n}. None -> the endpoint reports saved=False
+        # and the controller proceeds on window expiry instead of an ack.
+        self.checkpoint_hook = checkpoint_hook
         self._server: Optional[ThreadingHTTPServer] = None
         self._serve_lock = racecheck.make_lock("NotebookAgent._serve_lock")
         self._closed = False
@@ -380,12 +411,30 @@ class NotebookAgent:
         if path.endswith("/tpu/readiness"):
             visible = self.monitor.chips_visible()
             expected = self.monitor.chips_expected()
+            ici_degraded = self.monitor.ici_degraded()
             return {
                 "chips_visible": visible,
                 "chips_expected": expected,
-                "ready": expected > 0 and visible >= expected,
+                "ready": expected > 0 and visible >= expected and not ici_degraded,
                 "process_id": self.monitor.process_id(),
+                # device-level health for the TPUHealthy condition
+                # (controllers/probe_status.py): dead chips + degraded ICI
+                "device_health": self.monitor.device_health(),
+                "chips_failed": max(0, expected - visible),
+                "ici_degraded": ici_degraded,
             }
+        if path.endswith("/tpu/checkpoint"):
+            hook = self.checkpoint_hook
+            if hook is None:
+                return {"saved": False, "reason": "no checkpoint hook configured"}
+            try:
+                out = hook() or {}
+            except Exception as e:
+                # degrade into the response: the agent has no logger, and the
+                # repair controller treats a failed save as "proceed on
+                # window expiry" rather than blocking the evict forever
+                return {"saved": False, "reason": f"checkpoint hook failed: {e!r}"}
+            return {"saved": True, "step": out.get("step")}
         if path.endswith("/tpu/utilization"):
             lb = self.monitor.last_busy()
             return {

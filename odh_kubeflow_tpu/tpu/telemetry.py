@@ -51,6 +51,69 @@ device_memory_bytes = global_registry.gauge(
     labels=("device",),
 )
 
+# ---- slice interruption / repair telemetry (ISSUE 4): what the accelerator
+# layer does TO the fleet, and how fast the repair loop heals it. Sources:
+# controllers/slice_repair.py observes these at detection / completion. ----
+
+slice_interruptions_total = global_registry.counter(
+    "tpu_slice_interruptions_total",
+    "Slice-level interruptions detected (a Ready slice going Degraded), "
+    "by cause (HostPreempted | ChipFailure | ICIDegraded | HostUnreachable)",
+    labels=("cause",),
+)
+slice_repair_duration_seconds = global_registry.histogram(
+    "tpu_slice_repair_duration_seconds",
+    "Degraded -> Ready-again wall-clock per repaired slice (MTTR)",
+    buckets=(0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600),
+)
+slice_repairs_total = global_registry.counter(
+    "tpu_slice_repairs_total",
+    "Completed repair episodes, by result (repaired | failed)",
+    labels=("result",),
+)
+slice_checkpoint_saves_total = global_registry.counter(
+    "tpu_slice_checkpoint_saves_total",
+    "Hosts that acked a checkpoint save inside a checkpoint-before-evict "
+    "window",
+)
+slice_goodput_ratio = global_registry.gauge(
+    "tpu_slice_goodput_ratio",
+    "Cumulative fraction of tracked slice-lifetime spent Ready rather than "
+    "Degraded/Repairing (1.0 = no interruption downtime observed)",
+)
+
+
+class GoodputAccounting:
+    """Cumulative goodput bookkeeping behind `tpu_slice_goodput_ratio`.
+
+    The slice-repair controller calls `observe(lifetime_s, downtime_s)` on
+    every reconcile: the delta since the notebook was last seen extends
+    tracked lifetime, and counts as downtime when the notebook was in any
+    repair state for that interval. One process-wide instance — goodput is
+    a fleet number."""
+
+    def __init__(self) -> None:
+        from ..utils import racecheck
+
+        self._lock = racecheck.make_lock("GoodputAccounting._lock")
+        self._observed_s = 0.0
+        self._downtime_s = 0.0
+
+    def observe(self, lifetime_s: float, downtime_s: float = 0.0) -> None:
+        with self._lock:
+            self._observed_s += max(0.0, lifetime_s)
+            self._downtime_s += max(0.0, downtime_s)
+            ratio = (
+                max(0.0, 1.0 - self._downtime_s / self._observed_s)
+                if self._observed_s > 0
+                else None
+            )
+        if ratio is not None:
+            slice_goodput_ratio.set(ratio)
+
+
+goodput = GoodputAccounting()
+
 
 def observe_train_step(
     step_s: float,

@@ -139,3 +139,40 @@ def test_pp_sharded_state_save_restore(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b), err_msg=jax.tree_util.keystr(pa)
         )
+
+
+def test_make_checkpoint_hook_saves_and_reports_step(tmp_path):
+    """The probe agent's /tpu/checkpoint endpoint drives this hook during a
+    checkpoint-before-evict window (controllers/slice_repair.py): it must
+    save the live state and ack the step, and the saved checkpoint must
+    restore exactly."""
+    from odh_kubeflow_tpu.models import make_checkpoint_hook
+
+    state = {"w": jnp.arange(8, dtype=jnp.float32), "b": jnp.float32(3.0)}
+    directory = str(tmp_path / "ckpt")
+    hook = make_checkpoint_hook(directory, lambda: (7, state))
+
+    out = hook()
+    assert out == {"step": 7}
+    assert latest_step(directory) == 7
+    restored = restore_train_state(directory, state)
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8, dtype=np.float32))
+    assert float(restored["b"]) == 3.0
+
+    # the agent endpoint contract end-to-end: GET /tpu/checkpoint drives the
+    # hook and reports {"saved": true, "step": N}
+    from odh_kubeflow_tpu.probe import NotebookAgent, SimTPUMonitor
+
+    agent = NotebookAgent(monitor=SimTPUMonitor(), checkpoint_hook=hook)
+    assert agent.routes("/tpu/checkpoint") == {"saved": True, "step": 7}
+    agent_nohook = NotebookAgent(monitor=SimTPUMonitor())
+    assert agent_nohook.routes("/tpu/checkpoint")["saved"] is False
+
+
+def test_reinitialize_after_repair_single_host_noop():
+    """Single-host slices have no jax.distributed client; the post-repair
+    re-init is a no-op returning (0, 1) — and is safe to call repeatedly."""
+    from odh_kubeflow_tpu.parallel import reinitialize_after_repair
+
+    assert reinitialize_after_repair() == (0, 1)
+    assert reinitialize_after_repair() == (0, 1)

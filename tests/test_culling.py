@@ -218,6 +218,95 @@ def test_probe_failure_defers_culling(env):
 
 
 
+def test_culling_suspended_while_degraded_and_clock_resets_after_repair():
+    """ISSUE 4 satellite: the idleness clock is SUSPENDED while a notebook is
+    Degraded/mid-repair — a preempted notebook must not be culled for
+    "idling" during its own recovery — and restarts from the repair's
+    completion, so the notebook is (a) alive through a repair longer than the
+    cull threshold, (b) not culled immediately after repair, (c) still
+    cullable once genuinely idle afterwards."""
+    from odh_kubeflow_tpu.api.notebook import TPUSpec
+    from odh_kubeflow_tpu.controllers import (
+        ProbeStatusController,
+        SliceRepairController,
+    )
+
+    config = Config(
+        enable_culling=True,
+        cull_idle_time_min=1.5 / 60.0,  # 1.5 s idle threshold
+        idleness_check_period_min=0.1 / 60.0,
+        readiness_probe_period_s=0.1,
+        checkpoint_window_s=3.0,  # repair window > cull threshold: the
+        repair_backoff_s=0.3,     # suspension is what keeps it alive
+        repair_backoff_max_s=0.6,
+        repair_max_attempts=50,
+    )
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("pool", "v5e", "2x2")  # ONE slice: repair must wait
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, config).setup()
+    CullingReconciler(mgr, config, http_get=cluster.http_get).setup()
+    ProbeStatusController(mgr, config, http_get=cluster.http_get).setup()
+    SliceRepairController(mgr, config, http_get=cluster.http_get).setup()
+    agents = {}
+    # idle from the start: without the repair suspension this notebook gets
+    # culled the moment the 1.5 s idle threshold lapses
+    cluster.add_pod_behavior(
+        sim_agent_behavior(agents, duty=0.0, kernels_busy=False, chips=4)
+    )
+    mgr.start()
+    try:
+        cluster.client.create(
+            mk_nb("healing", tpu=TPUSpec(accelerator="v5e", topology="2x2"))
+        )
+        wait_for(
+            lambda: get_nb(cluster, "healing").status.tpu is not None
+            and get_nb(cluster, "healing").status.tpu.mesh_ready,
+            msg="mesh ready",
+        )
+        # preempt the only node, long grace: the notebook sits Degraded (pods
+        # still Ready, probes answering "idle") through the 3 s checkpoint
+        # window — far past the 1.5 s cull threshold
+        node = cluster.client.get(Pod, "user", "healing-0").spec.node_name
+        cluster.preempt_node(node, grace_s=10.0)
+        wait_for(
+            lambda: C.TPU_REPAIR_STATE_ANNOTATION
+            in get_nb(cluster, "healing").metadata.annotations,
+            msg="repair began",
+        )
+        # (a) degraded far longer than the cull threshold: never culled
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            assert (
+                C.STOP_ANNOTATION
+                not in get_nb(cluster, "healing").metadata.annotations
+            ), "culled mid-repair: the idleness clock was not suspended"
+            time.sleep(0.1)
+        # capacity returns; the gang re-places and the repair completes
+        cluster.restore_node(node)
+        wait_for(
+            lambda: C.TPU_REPAIR_STATE_ANNOTATION
+            not in get_nb(cluster, "healing").metadata.annotations
+            and get_nb(cluster, "healing").status.tpu.mesh_ready,
+            timeout=30,
+            msg="repaired",
+        )
+        # (b) the clock restarted at completion: no instant cull
+        nb = get_nb(cluster, "healing")
+        assert C.STOP_ANNOTATION not in nb.metadata.annotations
+        assert C.LAST_ACTIVITY_ANNOTATION in nb.metadata.annotations
+        # (c) but a genuinely idle notebook is still culled afterwards
+        wait_for(
+            lambda: C.STOP_ANNOTATION
+            in get_nb(cluster, "healing").metadata.annotations,
+            timeout=20,
+            msg="culled once idle after repair",
+        )
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
 def test_dev_mode_probes_through_local_proxy():
     """DEV mode (reference culling_controller.go:249-273): probes route
     through a localhost:8001 kubectl-proxy URL instead of the in-cluster

@@ -174,6 +174,90 @@ def test_pod_behavior_failure(cluster):
     assert pod.status.container_statuses[0].state.waiting["reason"] == "ImagePullBackOff"
 
 
+def test_gang_reschedules_when_capacity_frees(cluster):
+    """ISSUE 4 satellite: an Unschedulable gang is re-attempted the moment
+    pool capacity frees (scheduled-pod deletion / node events), not on the
+    next incidental event or backoff poll — proven by cranking the backoff
+    far beyond the test budget so only the capacity-freed watch can win."""
+    import time
+
+    shape = plan_slice("v5p", topology="2x2x2")  # 2 hosts
+    cluster.add_tpu_pool("pool-a", "v5p", "2x2x2")
+    cluster.client.create(
+        mk_sts("squatter", replicas=2, tpu_chips=4,
+               node_selector=shape.node_selector())
+    )
+    wait_ready(cluster, "user", "squatter", 2)
+
+    # any unschedulable requeue now sleeps 60s: rescheduling within the test
+    # budget MUST come from the event-driven capacity-freed path
+    cluster.scheduler.backoff_base_s = 60.0
+    cluster.scheduler.backoff_max_s = 60.0
+    cluster.client.create(
+        mk_sts("waiter", replicas=2, tpu_chips=4,
+               node_selector=shape.node_selector())
+    )
+    time.sleep(1.0)  # waiter fails at least one pass and enters backoff
+    waiter_pods = [
+        p for p in cluster.client.list(Pod, namespace="user")
+        if p.metadata.name.startswith("waiter")
+    ]
+    assert len(waiter_pods) == 2
+    assert all(not p.spec.node_name for p in waiter_pods), "all-or-nothing held"
+    events = cluster.client.list(Event, namespace="user")
+    assert any(e.reason == "FailedScheduling" for e in events)
+
+    # free the pool: the squatter scales away; its pods' DELETED events are
+    # the capacity-freed signal
+    sts = cluster.client.get(StatefulSet, "user", "squatter")
+    sts.spec.replicas = 0
+    cluster.client.update(sts)
+    wait_ready(cluster, "user", "waiter", 2, timeout=10)
+
+
+def test_preempted_node_drains_and_takes_no_new_pods(cluster):
+    """Host preemption substrate: the maintenance notice holds pods through
+    the grace window, the drain then kills them, and the tainted/NotReady
+    node is excluded from scheduling until restored."""
+    import time
+
+    from odh_kubeflow_tpu.api.core import Node
+    from odh_kubeflow_tpu.cluster.faults import PREEMPTION_TAINT_KEY
+
+    shape = plan_slice("v5e", topology="2x2")  # single host
+    cluster.add_tpu_pool("solo", "v5e", "2x2")
+    cluster.client.create(
+        mk_sts("nb", replicas=1, tpu_chips=4, node_selector=shape.node_selector())
+    )
+    sts = wait_ready(cluster, "user", "nb", 1)
+    node_name = cluster.client.get(Pod, "user", "nb-0").spec.node_name
+
+    cluster.preempt_node(node_name, grace_s=0.4)
+    # within the grace window the pod is still alive (checkpoint opportunity)
+    pod = cluster.client.get(Pod, "user", "nb-0")
+    assert pod.is_ready() and not pod.metadata.deletion_timestamp
+
+    # after the window: drained, node NotReady, replacement pod unschedulable
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        node = cluster.client.get(Node, "", node_name)
+        if any(c.type == "Ready" and c.status == "False"
+               for c in node.status.conditions):
+            break
+        time.sleep(0.05)
+    node = cluster.client.get(Node, "", node_name)
+    assert any(t["key"] == PREEMPTION_TAINT_KEY for t in node.spec["taints"])
+    assert any(c.type == "Ready" and c.status == "False"
+               for c in node.status.conditions)
+    time.sleep(0.5)
+    pod = cluster.client.get(Pod, "user", "nb-0")  # recreated by the STS
+    assert not pod.spec.node_name, "scheduler placed a pod on a drained node"
+
+    # maintenance ends: capacity returns and the pod lands again
+    cluster.restore_node(node_name)
+    wait_ready(cluster, "user", "nb", 1)
+
+
 def test_cpu_pods_never_land_on_tpu_hosts(cluster):
     # GKE TPU pools are tainted google.com/tpu: CPU pods must avoid them
     cluster.add_tpu_pool("tpu-pool", "v5e", "2x2")
