@@ -748,6 +748,41 @@ def _bench_slice_repair(cluster, deadline_s=60.0):
     }
 
 
+def _bench_slo_and_canary(mgr, min_probes: int = 3, wait_s: float = 30.0):
+    """Wait for the canary to finish a few probes, then report the SLO
+    engine's compliance verdicts and the canary latency percentiles."""
+    from odh_kubeflow_tpu.runtime.prober import (
+        canary_probe_latency_seconds,
+        canary_probes_total,
+    )
+    from odh_kubeflow_tpu.runtime.slo import slo_compliance_ratio
+
+    deadline = time.monotonic() + wait_s
+    while (
+        canary_probes_total.sum_matching({}) < min_probes
+        and time.monotonic() < deadline
+    ):
+        time.sleep(0.1)
+    if mgr.slo_engine is not None:
+        mgr.slo_engine.evaluate()  # one fresh tick so gauges reflect now
+    compliance = {
+        slo.name: round(slo_compliance_ratio.value(slo=slo.name), 6)
+        for slo in (mgr.slo_engine.slos if mgr.slo_engine else ())
+        if "readiness" in slo.name
+    }
+    total = canary_probes_total.sum_matching({})
+    ok = canary_probes_total.value(result="ok")
+    return {
+        "compliance": compliance,
+        "canary": {
+            "probes": int(total),
+            "ok": int(ok),
+            "p50_s": canary_probe_latency_seconds.percentile(0.5),
+            "p99_s": canary_probe_latency_seconds.percentile(0.99),
+        },
+    }
+
+
 def bench_control_plane():
     from odh_kubeflow_tpu.api.core import Container
     from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
@@ -772,13 +807,26 @@ def bench_control_plane():
     cluster = SimCluster().start()
     agents = {}
     cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
-    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=SINGLE_HOST_NOTEBOOKS)
+    # +1 spare v5e slice: the black-box canary drives one tiny notebook at a
+    # time through the full readiness path and needs a slice of its own
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=SINGLE_HOST_NOTEBOOKS + 1)
     # +1 spare v5p slice: the repair episode below needs a same-topology
     # fallback pool for its all-or-nothing gang re-placement
     cluster.add_tpu_pool("v5p", "v5p", "2x2x4", slices=MULTI_HOST_NOTEBOOKS + 1)
 
     mgr = build_manager(
-        cluster.store, Config(readiness_probe_period_s=0.2), http_get=cluster.http_get
+        cluster.store,
+        Config(
+            readiness_probe_period_s=0.2,
+            # SLO engine on scaled windows (5m -> 6s) so compliance numbers
+            # settle within the bench run; canary probing continuously
+            slo_window_scale=0.02,
+            canary_period_s=1.0,
+            canary_timeout_s=30.0,
+            canary_accelerator="v5e",
+            canary_topology="2x2",
+        ),
+        http_get=cluster.http_get,
     )
     mgr.start()
 
@@ -814,12 +862,29 @@ def bench_control_plane():
             slice_repair = _bench_slice_repair(cluster)
         except Exception as e:
             slice_repair = {"error": repr(e)[:300]}
+
+        # SLO verdicts + canary numbers (ISSUE 5): give the black-box prober
+        # a few more round trips, then read what the judgement layer says
+        # about the storm this bench just ran
+        try:
+            slo_section = _bench_slo_and_canary(mgr)
+        except Exception as e:
+            slo_section = {"error": repr(e)[:300]}
     finally:
         mgr.stop()
         cluster.stop()
 
+    out_slo = {
+        "slo_readiness_compliance": slo_section.get("compliance"),
+        "canary_probe": slo_section.get("canary"),
+    }
+    if "error" in slo_section:
+        # keep the failure visible (the slice_repair section does the same):
+        # nulls alone are indistinguishable from "not yet settled"
+        out_slo["slo_error"] = slo_section["error"]
     return {
         "slice_repair": slice_repair,
+        **out_slo,
         "cr_to_mesh_ready_p50_s": round(statistics.median(latencies.values()), 4),
         # where the time goes: per-phase p50 from the connected readiness
         # traces (root notebook.ready = CR submit -> jax.devices ready)

@@ -39,7 +39,9 @@ RACECHECK=1 python -m pytest tests/test_faults.py -q -m "faults and not slow" \
 # repair path — the seeded slice "bad day" asserts the acceptance invariant
 # (every faulted notebook returns to Ready with a slice.repair trace, or
 # ends in an explicit RepairFailed event; zero silently stuck), rerun under
-# the same stress loop + one RACECHECK=1 iteration
+# the same stress loop + one RACECHECK=1 iteration. Since ISSUE 5 the soak
+# also asserts the flight recorder captured >= 1 slice-degraded incident
+# bundle — every iteration below doubles as that observability gate.
 for i in $(seq 1 "$REPEAT"); do
     echo "=== slice chaos lane: iteration $i/$REPEAT ==="
     python -m pytest tests/test_slice_repair.py -q -m "slice_repair and not slow" \

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# SLO definition lint lane (ISSUE 5 satellite): every SLO indicator and
+# alert rule must reference metric families that actually exist in the live
+# registry — validated by analysis/metric_rules.py check_slo_definitions,
+# the same one-source-of-truth pattern as the registry lint — then the
+# slo-marked pytest contract tests rerun (burn-rate math, alert lifecycle,
+# inhibition, flight-recorder bundles, the bad-day acceptance soak).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== slo definition lint (delegated to odh_kubeflow_tpu.analysis) =="
+python -m odh_kubeflow_tpu.analysis --slo-lint
+
+echo "== slo contract tests =="
+python -m pytest tests/ -q -m "slo and not slow" -p no:cacheprovider

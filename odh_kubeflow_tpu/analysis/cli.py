@@ -5,6 +5,8 @@
     python -m odh_kubeflow_tpu.analysis --include-suppressed  # audit pragmas
     python -m odh_kubeflow_tpu.analysis --registry-lint       # live-registry
                                     # naming rules (ci/metrics_lint.sh lane)
+    python -m odh_kubeflow_tpu.analysis --slo-lint            # SLO/alert defs
+                                    # vs live registry (ci/slo_lint.sh lane)
 
 Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
 """
@@ -44,6 +46,38 @@ def _registry_lint() -> int:
     return 0
 
 
+def _slo_lint() -> int:
+    """Import every metric-registration site plus the SLO/alert/prober
+    definitions, then lint the definitions against the live registry — the
+    ci/slo_lint.sh entry (metric_rules.check_slo_definitions is the one
+    source of truth, like the registry lint)."""
+    import odh_kubeflow_tpu.runtime.controller  # noqa: F401
+    import odh_kubeflow_tpu.runtime.flightrecorder  # noqa: F401
+    import odh_kubeflow_tpu.runtime.metrics as m
+    import odh_kubeflow_tpu.runtime.prober  # noqa: F401  (canary families)
+    import odh_kubeflow_tpu.tpu.telemetry  # noqa: F401
+    from odh_kubeflow_tpu.controllers.metrics import NotebookMetrics
+    from odh_kubeflow_tpu.runtime.alerts import default_rules
+    from odh_kubeflow_tpu.runtime.slo import default_slos
+
+    from .metric_rules import check_slo_definitions
+
+    NotebookMetrics(m.global_registry)  # controller series register in __init__
+    slos = default_slos()
+    rules = default_rules(slos)
+    violations = check_slo_definitions(slos, rules, m.global_registry)
+    if violations:
+        print("slo lint FAILED:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print(
+        f"slo lint OK: {len(slos)} SLOs, {len(rules)} alert rules, every "
+        "referenced metric registered"
+    )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m odh_kubeflow_tpu.analysis",
@@ -65,6 +99,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--registry-lint", action="store_true",
         help="lint the live metrics registry instead of source files",
     )
+    parser.add_argument(
+        "--slo-lint", action="store_true",
+        help="lint SLO/alert-rule definitions against the live registry "
+        "(the ci/slo_lint.sh lane)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_checks:
@@ -73,6 +112,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.registry_lint:
         return _registry_lint()
+    if args.slo_lint:
+        return _slo_lint()
 
     if args.paths:
         paths = args.paths

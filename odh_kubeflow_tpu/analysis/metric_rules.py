@@ -35,6 +35,105 @@ def check_metric(
     return violations
 
 
+def check_slo_definitions(slos, rules, registry) -> List[str]:
+    """Lint SLO + alert-rule definitions against the LIVE registry — the
+    ci/slo_lint.sh contract (same one-source-of-truth pattern as
+    check_registry): every metric an indicator references must be a
+    registered family of the right type, latency thresholds must sit on a
+    real bucket boundary, and every alert rule must reference a defined SLO
+    and known windows."""
+    from odh_kubeflow_tpu.runtime.metrics import Counter, Gauge, Histogram
+    from odh_kubeflow_tpu.runtime.slo import (
+        EventRatioIndicator,
+        GaugeIndicator,
+        LatencyIndicator,
+        WINDOW_SECONDS,
+    )
+
+    violations: List[str] = []
+    names = set()
+    for slo in slos:
+        names.add(slo.name)
+        if not (0.0 < slo.objective < 1.0):
+            violations.append(
+                f"slo {slo.name}: objective {slo.objective} outside (0, 1)"
+            )
+        for metric_name in slo.metric_names():
+            if registry.get(metric_name) is None:
+                violations.append(
+                    f"slo {slo.name}: references unregistered metric "
+                    f"{metric_name!r}"
+                )
+        indicator = slo.indicator
+        if isinstance(indicator, LatencyIndicator):
+            metric = registry.get(indicator.histogram)
+            if metric is not None and not isinstance(metric, Histogram):
+                violations.append(
+                    f"slo {slo.name}: {indicator.histogram} is not a histogram"
+                )
+            elif metric is not None and indicator.threshold_s not in metric.buckets:
+                violations.append(
+                    f"slo {slo.name}: threshold {indicator.threshold_s}s is not "
+                    f"a bucket boundary of {indicator.histogram} "
+                    f"(buckets: {metric.buckets})"
+                )
+        elif isinstance(indicator, EventRatioIndicator):
+            metric = registry.get(indicator.counter)
+            if metric is not None and not isinstance(metric, Counter):
+                violations.append(
+                    f"slo {slo.name}: {indicator.counter} is not a counter"
+                )
+            elif metric is not None:
+                unknown = [
+                    label for label, _ in indicator.good_labels
+                    if label not in metric.label_names
+                ]
+                if unknown:
+                    violations.append(
+                        f"slo {slo.name}: good_labels {unknown} not labels of "
+                        f"{indicator.counter} (has {list(metric.label_names)})"
+                    )
+        elif isinstance(indicator, GaugeIndicator):
+            metric = registry.get(indicator.gauge)
+            if metric is not None and not isinstance(metric, Gauge):
+                violations.append(
+                    f"slo {slo.name}: {indicator.gauge} is not a gauge"
+                )
+        else:
+            violations.append(
+                f"slo {slo.name}: unknown indicator type "
+                f"{type(indicator).__name__}"
+            )
+    budgets = {slo.name: slo.error_budget for slo in slos}
+    for rule in rules:
+        if rule.slo not in names:
+            violations.append(
+                f"alert rule {rule.name}: references undefined SLO {rule.slo!r}"
+            )
+        for window in (rule.long_window, rule.short_window):
+            if window not in WINDOW_SECONDS:
+                violations.append(
+                    f"alert rule {rule.name}: unknown window {window!r} "
+                    f"(known: {sorted(WINDOW_SECONDS)})"
+                )
+        if rule.burn_threshold <= 0:
+            violations.append(
+                f"alert rule {rule.name}: burn threshold must be > 0"
+            )
+        elif rule.slo in budgets:
+            # burn = (1 - compliance) / budget is capped at 1/budget: a
+            # threshold above the cap can never fire — a silently dead rule
+            max_burn = 1.0 / budgets[rule.slo]
+            if rule.burn_threshold > max_burn:
+                violations.append(
+                    f"alert rule {rule.name}: threshold "
+                    f"{rule.burn_threshold}x exceeds the maximum possible "
+                    f"burn {max_burn:.1f}x for objective "
+                    f"{1.0 - budgets[rule.slo]:g} — the rule can never fire"
+                )
+    return violations
+
+
 def check_registry(registry) -> List[str]:
     """Runtime lint of a live Registry: naming rules over every registered
     family, plus the exposition-completeness check (every family must appear

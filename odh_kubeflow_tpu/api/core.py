@@ -270,6 +270,63 @@ class Event(KubeObject):
     reporting_component: str = ""
 
 
+def emit_deduped_event(
+    client,
+    owner: KubeObject,
+    name: str,
+    reason: str,
+    message: str,
+    etype: str = "Warning",
+    api_version: str = "",
+    kind: str = "",
+) -> None:
+    """Kubernetes-style deduplicated Event on `owner`: a repeat of the same
+    event `name` bumps count/lastTimestamp instead of piling up objects; the
+    first occurrence is created with an ownerRef so it's GC'd with the
+    owner. The ONE emitter behind the scheduler's Unschedulable events, the
+    slice-repair episode events, and the alert manager's SLOBurnRate events
+    — dedup/race semantics live here exactly once."""
+    from ..apimachinery import AlreadyExistsError, NotFoundError, now_rfc3339
+
+    namespace = owner.metadata.namespace
+    try:
+        existing = client.get(Event, namespace, name)
+        client.patch(
+            Event,
+            namespace,
+            name,
+            {
+                "count": existing.count + 1,
+                "lastTimestamp": now_rfc3339(),
+                "message": message,
+            },
+        )
+        return
+    except NotFoundError:
+        pass
+    ev = Event()
+    ev.metadata.name = name
+    ev.metadata.namespace = namespace
+    ev.involved_object = ObjectReference(
+        api_version=api_version or owner.api_version,
+        kind=kind or owner.kind or type(owner).__name__,
+        name=owner.metadata.name,
+        namespace=namespace,
+        uid=owner.metadata.uid,
+    )
+    ev.set_owner(owner)  # GC'd with the owner
+    ev.reason = reason
+    ev.type = etype
+    ev.message = message
+    ev.first_timestamp = now_rfc3339()
+    ev.last_timestamp = now_rfc3339()
+    ev.count = 1
+    try:
+        client.create(ev)
+    except AlreadyExistsError:
+        pass  # racing emitter created it; count bump next time
+
+
 @dataclass
 class Namespace(KubeObject):
     status: Dict[str, Any] = field(default_factory=dict)

@@ -21,13 +21,8 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ..api.apps import StatefulSet
-from ..api.core import Event, Node, ObjectReference, Pod
-from ..apimachinery import (
-    AlreadyExistsError,
-    NotFoundError,
-    controller_owner,
-    now_rfc3339,
-)
+from ..api.core import Node, Pod, emit_deduped_event
+from ..apimachinery import NotFoundError, controller_owner
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import GKE_NODEPOOL_LABEL, TPU_RESOURCE
@@ -247,44 +242,17 @@ class Scheduler:
         return None
 
     def _emit_unschedulable(self, pod: Pod, tpu_chips: int) -> None:
-        """One Event per pod+reason, deduplicated Kubernetes-style: repeats
-        bump count/lastTimestamp instead of growing the store."""
-        name = f"{pod.metadata.name}.unschedulable"
+        """One Event per pod+reason, deduplicated Kubernetes-style via the
+        shared emitter (api/core.py emit_deduped_event): repeats bump
+        count/lastTimestamp instead of growing the store."""
         message = (
             f"0/{len(self.client.list(Node))} nodes available for "
             f"{tpu_chips} {TPU_RESOURCE} chips (gang all-or-nothing)"
             if tpu_chips
             else "no node with sufficient cpu/memory"
         )
-        try:
-            existing = self.client.get(Event, pod.metadata.namespace, name)
-            self.client.patch(
-                Event,
-                pod.metadata.namespace,
-                name,
-                {"count": existing.count + 1, "lastTimestamp": now_rfc3339(), "message": message},
-            )
-            return
-        except NotFoundError:
-            pass
-        ev = Event()
-        ev.metadata.name = name
-        ev.metadata.namespace = pod.metadata.namespace
-        ev.involved_object = ObjectReference(
-            api_version="v1",
-            kind="Pod",
-            name=pod.metadata.name,
-            namespace=pod.metadata.namespace,
-            uid=pod.metadata.uid,
+        emit_deduped_event(
+            self.client, pod, f"{pod.metadata.name}.unschedulable",
+            reason="FailedScheduling", message=message, etype="Warning",
+            api_version="v1", kind="Pod",
         )
-        ev.set_owner(pod)  # GC'd with the pod
-        ev.reason = "FailedScheduling"
-        ev.type = "Warning"
-        ev.message = message
-        ev.first_timestamp = now_rfc3339()
-        ev.last_timestamp = now_rfc3339()
-        ev.count = 1
-        try:
-            self.client.create(ev)
-        except AlreadyExistsError:
-            pass

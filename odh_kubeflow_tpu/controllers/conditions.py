@@ -19,10 +19,16 @@ from ..apimachinery import Condition, NotFoundError
 from ..apimachinery import get_condition as _get_in_list
 from ..apimachinery import set_condition as _upsert_in_list
 from ..cluster.client import retry_on_conflict
+from ..runtime.flightrecorder import recorder
 from . import constants as C
 
-# condition types owned by the repair stack, NOT the pod-condition mirror
-REPAIR_OWNED_CONDITIONS = (C.TPU_HEALTHY_CONDITION, C.TPU_DEGRADED_CONDITION)
+# condition types owned by the repair/SLO stack, NOT the pod-condition
+# mirror (the mirror preserves these when rebuilding from pod 0)
+REPAIR_OWNED_CONDITIONS = (
+    C.TPU_HEALTHY_CONDITION,
+    C.TPU_DEGRADED_CONDITION,
+    C.SLO_DEGRADED_CONDITION,
+)
 
 
 def get_condition(nb: Notebook, ctype: str) -> Optional[Condition]:
@@ -65,7 +71,9 @@ def write_condition(
     message: str = "",
 ) -> None:
     """Write one condition via fresh-read RMW under conflict retry. No-ops
-    (same status/reason/message) cost one read and zero writes."""
+    (same status/reason/message) cost one read and zero writes. Writes that
+    actually land are sampled into the flight-recorder ring — condition
+    transitions are the incident bundle's state-machine timeline."""
     # cheap pre-check against the object in hand; a stale cache self-heals
     # level-triggered (the event that updates it re-enqueues the notebook)
     cur = get_condition(nb, ctype)
@@ -73,12 +81,22 @@ def write_condition(
             and cur.message == message:
         return
 
-    def attempt() -> None:
+    def attempt() -> bool:
         fresh = api_reader.get(Notebook, nb.metadata.namespace, nb.metadata.name)
         if upsert_condition(fresh.status.conditions, ctype, status, reason, message):
             client.update_status(fresh)
+            return True
+        return False
 
     try:
-        retry_on_conflict(attempt)
+        changed = retry_on_conflict(attempt)
     except NotFoundError:
         return  # deleted mid-reconcile
+    if changed:
+        recorder.record(
+            "condition",
+            notebook=f"{nb.metadata.namespace}/{nb.metadata.name}",
+            type=ctype,
+            status=status,
+            reason=reason,
+        )

@@ -49,6 +49,20 @@ class Config:
     repair_max_attempts: int = 6
     repair_backoff_s: float = 1.0
     repair_backoff_max_s: float = 30.0
+    # SLO engine + alerting (runtime/slo.py, runtime/alerts.py): window_scale
+    # shrinks the canonical 5m/30m/1h/6h burn windows (soaks/tests run the
+    # real rule shapes in seconds); eval period 0 derives from the scale
+    slo_enabled: bool = True
+    slo_window_scale: float = 1.0
+    slo_eval_period_s: float = 0.0
+    # black-box canary prober (runtime/prober.py): period 0 disables; an
+    # accelerator/topology makes the canary exercise the device-visibility
+    # gate instead of a plain CPU notebook
+    canary_period_s: float = 0.0
+    canary_timeout_s: float = 120.0
+    canary_namespace: str = "slo-canary"
+    canary_accelerator: str = ""
+    canary_topology: str = ""
     # MaxConcurrentReconciles analog: worker threads per controller. The
     # workqueue's per-key single-flight makes >1 safe; under create storms
     # (and over the higher-latency remote transport) it is the difference
@@ -105,6 +119,21 @@ class Config:
             c.repair_backoff_s = float(os.environ["REPAIR_BACKOFF_S"])
         if os.environ.get("REPAIR_BACKOFF_MAX_S"):
             c.repair_backoff_max_s = float(os.environ["REPAIR_BACKOFF_MAX_S"])
+        c.slo_enabled = _env_bool("SLO_ENABLED", c.slo_enabled)
+        if os.environ.get("SLO_WINDOW_SCALE"):
+            # clamp: non-positive would collapse every burn window to zero
+            c.slo_window_scale = max(1e-6, float(os.environ["SLO_WINDOW_SCALE"]))
+        if os.environ.get("SLO_EVAL_PERIOD_S"):
+            c.slo_eval_period_s = max(0.0, float(os.environ["SLO_EVAL_PERIOD_S"]))
+        if os.environ.get("CANARY_PERIOD_S"):
+            c.canary_period_s = max(0.0, float(os.environ["CANARY_PERIOD_S"]))
+        if os.environ.get("CANARY_TIMEOUT_S"):
+            c.canary_timeout_s = max(1.0, float(os.environ["CANARY_TIMEOUT_S"]))
+        c.canary_namespace = os.environ.get("CANARY_NAMESPACE", c.canary_namespace)
+        c.canary_accelerator = os.environ.get(
+            "CANARY_ACCELERATOR", c.canary_accelerator
+        )
+        c.canary_topology = os.environ.get("CANARY_TOPOLOGY", c.canary_topology)
         if os.environ.get("MAX_CONCURRENT_RECONCILES"):
             # clamp: 0/negative would spawn no workers and silently disable
             # every controller

@@ -60,10 +60,14 @@ TPU_REPAIR_CAUSE_ANNOTATION = "notebooks.tpu.kubeflow.org/repair-cause"
 TPU_CHECKPOINT_REQUEST_ANNOTATION = "notebooks.tpu.kubeflow.org/checkpoint-before-evict"
 TPU_CHECKPOINT_SAVED_ANNOTATION = "notebooks.tpu.kubeflow.org/checkpoint-saved"
 
-# condition types on NotebookStatus (owned by probe_status / slice_repair;
-# the core reconciler's pod-condition mirror preserves these)
+# condition types on NotebookStatus (owned by probe_status / slice_repair /
+# the alert manager; the core reconciler's pod-condition mirror preserves
+# these)
 TPU_HEALTHY_CONDITION = "TPUHealthy"
 TPU_DEGRADED_CONDITION = "Degraded"
+# stamped by the alert manager (runtime/alerts.py) on the worst offenders
+# while a burn-rate alert fires; cleared (reason Recovered) at resolution
+SLO_DEGRADED_CONDITION = "DegradedSLO"
 
 # -- TPU-native additions --
 TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"

@@ -28,6 +28,7 @@ from ..apimachinery import NotFoundError, now_rfc3339, parse_time, rfc3339
 from ..cluster.client import retry_on_conflict
 from ..runtime.breaker import CircuitBreaker
 from ..runtime.controller import Request, Result
+from ..runtime.flightrecorder import recorder
 from ..runtime.manager import Manager
 from ..tpu import plan_slice
 from . import constants as C
@@ -307,6 +308,12 @@ class CullingReconciler:
             self._patch_annotations(nb, updates)
             self.metrics.notebook_culling_total.inc()
             self.metrics.last_culling_timestamp.set(time.time())
+            # flight recorder: a cull is a state-machine transition a later
+            # incident bundle must explain ("who scaled this slice away?")
+            recorder.record(
+                "transition", machine="culling", notebook=req.key,
+                state="culled", idle_s=round(idle_s, 1),
+            )
             log.info("culled %s after %.0fs idle", req.key, idle_s)
             return None
         self._patch_annotations(nb, updates)

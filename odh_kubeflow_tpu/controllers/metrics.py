@@ -65,6 +65,16 @@ class NotebookMetrics:
             "TPU chips allocatable in the cluster, by accelerator",
             labels=("accelerator",),
         )
+        # availability SLI (runtime/slo.py notebook-availability objective):
+        # of the non-stopped TPU notebooks that have EVER been mesh-ready,
+        # the fraction mesh-ready right now. Previously-ready only, so fleet
+        # bring-up doesn't read as an availability incident — bring-up is
+        # the readiness-latency SLO's jurisdiction
+        self.notebook_available_ratio = registry.gauge(
+            "notebook_available_ratio",
+            "Fraction of previously-ready, non-stopped TPU notebooks "
+            "currently mesh-ready (1.0 when none qualify)",
+        )
         self._seen_accelerators: set = set()
         if client is not None:
             registry.add_collector(self._scrape)
@@ -95,6 +105,32 @@ class NotebookMetrics:
                     chips += ready * int(float(c.resources.requests[TPU_RESOURCE]))
         self.notebook_running.set(running)
         self.tpu_chips_bound.set(chips)
+
+        qualifying = available = 0
+        try:
+            for nb in self.client.list(Notebook):
+                if (
+                    nb.spec.tpu is None
+                    or not nb.spec.tpu.accelerator
+                    or nb.metadata.deletion_timestamp
+                    or C.STOP_ANNOTATION in nb.metadata.annotations
+                    or nb.status.tpu is None
+                    or not nb.status.tpu.first_ready_time
+                ):
+                    continue
+                qualifying += 1
+                if nb.status.tpu.mesh_ready:
+                    available += 1
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "availability scrape: Notebook list failed: %r", e
+            )
+        else:
+            self.notebook_available_ratio.set(
+                available / qualifying if qualifying else 1.0
+            )
 
         capacity: dict = {}
         try:

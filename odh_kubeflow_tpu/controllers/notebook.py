@@ -45,6 +45,7 @@ from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
 from ..runtime.manager import Manager
 from ..tpu import SliceShape, TPU_RESOURCE, plan_slice, tpu_env, ordinal_env
+from ..utils import tracing
 from ..utils.tracing import reconcile_tracer
 from . import constants as C
 from .conditions import REPAIR_OWNED_CONDITIONS
@@ -268,8 +269,14 @@ class NotebookReconciler:
         try:
             nb = self.client.get(Notebook, req.namespace, req.name)
         except NotFoundError:
+            # the CR is gone: close the readiness root the webhook opened
+            # under this key, or a deleted-before-ready notebook leaks its
+            # root until capacity eviction (tracing_roots_evicted_total
+            # reason="deleted" counts these)
+            tracing.discard_root_for(req.key)
             return None
         if nb.metadata.deletion_timestamp:
+            tracing.discard_root_for(req.key)
             return None
 
         shape = self.plan(nb)

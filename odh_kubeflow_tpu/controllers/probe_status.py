@@ -31,6 +31,7 @@ from ..api.notebook import Notebook, TPUStatus
 from ..apimachinery import NotFoundError, now_rfc3339, parse_time
 from ..cluster.client import retry_on_conflict
 from ..runtime.controller import Request, Result
+from ..runtime.flightrecorder import recorder
 from ..runtime.manager import Manager
 from ..tpu import plan_slice
 from ..utils import tracing
@@ -208,6 +209,15 @@ class ProbeStatusController:
         newly_ready = mesh_ready and not (
             nb.status.tpu and nb.status.tpu.first_ready_time
         )
+        # flight-recorder sample on gate FLIPS only (a steady-state sweep is
+        # not evidence): the mesh going un-ready after first-ready is the
+        # leading edge of every degradation incident
+        was_ready = bool(nb.status.tpu and nb.status.tpu.mesh_ready)
+        if mesh_ready != was_ready:
+            recorder.record(
+                "mesh", notebook=req.key, ready=mesh_ready,
+                chips_visible=chips_visible, hosts_ready=ready_pods,
+            )
         newly_ready = self._write(nb, chips_visible, mesh_ready, newly_ready)
         if newly_ready:
             # observe only after the write persisted (double-count guard)

@@ -49,10 +49,9 @@ import random
 import time
 from typing import Dict, List, Optional, Tuple
 
-from ..api.core import Event, Node, ObjectReference, Pod
+from ..api.core import Node, Pod, emit_deduped_event
 from ..api.notebook import Notebook
 from ..apimachinery import (
-    AlreadyExistsError,
     NotFoundError,
     now_rfc3339,
     parse_time,
@@ -61,6 +60,7 @@ from ..apimachinery import (
 from ..cluster.client import retry_on_conflict
 from ..cluster.faults import MAINTENANCE_WINDOW_ANNOTATION, PREEMPTION_TAINT_KEY
 from ..runtime.controller import Request, Result
+from ..runtime.flightrecorder import recorder
 from ..runtime.manager import Manager
 from ..tpu import plan_slice, telemetry
 from ..utils.tracing import record_span
@@ -108,6 +108,10 @@ class SliceRepairController:
         self._next_attempt: Dict[str, float] = {}
         self._evicted_at: Dict[str, float] = {}
         self._ckpt_acked: Dict[str, Dict[int, Optional[int]]] = {}
+        # notebooks currently inside a repair episode, mirrored into the
+        # tpu_slice_repairs_in_progress gauge (the alert manager's
+        # slice-repair inhibitor reads it)
+        self._in_repair: set = set()
 
     def setup(self) -> None:
         def pod_is_labeled(ev: str, obj: dict, old: Optional[dict]) -> bool:
@@ -160,6 +164,10 @@ class SliceRepairController:
 
         ann = nb.metadata.annotations
         state = ann.get(C.TPU_REPAIR_STATE_ANNOTATION, "")
+        # gauge for the alert manager's inhibitor: an ACTIVE episode
+        # (degraded/repairing) inhibits readiness-category alerts; terminal
+        # RepairFailed does not — a permanently broken slice must page
+        self._track_repair(req.key, state in (STATE_DEGRADED, STATE_REPAIRING))
 
         if C.STOP_ANNOTATION in ann:
             # stopped (user or culler): a scaled-away slice has nothing to
@@ -358,6 +366,19 @@ class SliceRepairController:
         )
         self._emit_event(nb, "SliceDegraded", f"slice degraded ({cause}): {message}")
         telemetry.slice_interruptions_total.inc(cause=cause)
+        key = f"{nb.metadata.namespace}/{nb.metadata.name}"
+        self._track_repair(key, True)
+        # flight recorder: the Degraded entry IS an incident — snapshot the
+        # ring + CR/pod state now, while the evidence is still in the buffer
+        recorder.record(
+            "transition", machine="slice-repair", notebook=key,
+            state=STATE_DEGRADED, cause=cause,
+        )
+        recorder.snapshot(
+            "slice-degraded", subject=key, client=self.client,
+            notebooks=[(nb.metadata.namespace, nb.metadata.name)],
+            extra={"cause": cause, "message": message},
+        )
         log.warning(
             "slice degraded: %s/%s (%s) — checkpoint window until %s",
             nb.metadata.namespace, nb.metadata.name, cause, rfc3339_precise(deadline),
@@ -444,6 +465,10 @@ class SliceRepairController:
         self._evict(nb, pods)
         self._evicted_at[req.key] = now
         self._next_attempt[req.key] = now + self._backoff(1)
+        recorder.record(
+            "transition", machine="slice-repair", notebook=req.key,
+            state=STATE_REPAIRING, hosts_acked=len(acked),
+        )
         log.info(
             "slice repair: evicted gang of %s/%s (%d/%d hosts checkpointed)",
             nb.metadata.namespace, nb.metadata.name, len(acked), shape.hosts,
@@ -552,6 +577,11 @@ class SliceRepairController:
             etype="Normal",
         )
         self._next_attempt.pop(req.key, None)
+        self._track_repair(req.key, False)
+        recorder.record(
+            "transition", machine="slice-repair", notebook=req.key,
+            state="ready", mttr_s=round(mttr, 3), cause=cause,
+        )
         log.info(
             "slice repaired: %s/%s in %.2fs (%s)",
             nb.metadata.namespace, nb.metadata.name, mttr, cause,
@@ -588,6 +618,16 @@ class SliceRepairController:
         self._emit_event(nb, "RepairFailed", msg)
         self._next_attempt.pop(req.key, None)
         self._evicted_at.pop(req.key, None)
+        self._track_repair(req.key, False)
+        recorder.record(
+            "transition", machine="slice-repair", notebook=req.key,
+            state=STATE_FAILED, cause=cause,
+        )
+        recorder.snapshot(
+            "repair-failed", subject=req.key, client=self.client,
+            notebooks=[(nb.metadata.namespace, nb.metadata.name)],
+            extra={"cause": cause, "attempts": self.config.repair_max_attempts},
+        )
         log.error("slice repair FAILED: %s/%s (%s)",
                   nb.metadata.namespace, nb.metadata.name, cause)
         return None
@@ -695,11 +735,23 @@ class SliceRepairController:
             C.TPU_CHECKPOINT_REQUEST_ANNOTATION: None,
         }
 
+    def _track_repair(self, key: str, active: bool) -> None:
+        if active:
+            self._in_repair.add(key)
+        else:
+            self._in_repair.discard(key)
+        # written unconditionally (not only on change): the gauge is
+        # process-global, and a controller stopped mid-episode leaves a
+        # stale non-zero value a fresh instance's empty set would otherwise
+        # never overwrite — permanently inhibiting readiness alerts
+        telemetry.slice_repairs_in_progress.set(float(len(self._in_repair)))
+
     def _forget(self, key: str) -> None:
         self._last_seen.pop(key, None)
         self._next_attempt.pop(key, None)
         self._evicted_at.pop(key, None)
         self._ckpt_acked.pop(key, None)
+        self._track_repair(key, False)
 
     def _patch_annotations(self, nb: Notebook, updates: dict) -> None:
         def attempt():
@@ -718,43 +770,12 @@ class SliceRepairController:
     def _emit_event(
         self, nb: Notebook, reason: str, message: str, etype: str = "Warning"
     ) -> None:
-        """One Event per notebook+reason, deduplicated Kubernetes-style
-        (repeats bump count/lastTimestamp — same pattern as the scheduler's
-        Unschedulable events)."""
-        name = f"{nb.metadata.name}.{reason.lower()}"
-        try:
-            existing = self.client.get(Event, nb.metadata.namespace, name)
-            self.client.patch(
-                Event,
-                nb.metadata.namespace,
-                name,
-                {
-                    "count": existing.count + 1,
-                    "lastTimestamp": now_rfc3339(),
-                    "message": message,
-                },
-            )
-            return
-        except NotFoundError:
-            pass
-        ev = Event()
-        ev.metadata.name = name
-        ev.metadata.namespace = nb.metadata.namespace
-        ev.involved_object = ObjectReference(
+        """One Event per notebook+reason, deduplicated Kubernetes-style via
+        the shared emitter (api/core.py emit_deduped_event — same mechanics
+        as the scheduler's Unschedulable events)."""
+        emit_deduped_event(
+            self.client, nb, f"{nb.metadata.name}.{reason.lower()}",
+            reason=reason, message=message, etype=etype,
             api_version=nb.api_version or "kubeflow.org/v1beta1",
             kind="Notebook",
-            name=nb.metadata.name,
-            namespace=nb.metadata.namespace,
-            uid=nb.metadata.uid,
         )
-        ev.set_owner(nb)  # GC'd with the notebook
-        ev.reason = reason
-        ev.type = etype
-        ev.message = message
-        ev.first_timestamp = now_rfc3339()
-        ev.last_timestamp = now_rfc3339()
-        ev.count = 1
-        try:
-            self.client.create(ev)
-        except AlreadyExistsError:
-            pass  # racing worker emitted it; count bump next time

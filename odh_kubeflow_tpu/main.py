@@ -57,7 +57,57 @@ def build_manager(
     ProbeStatusController(mgr, config, http_get=http_get, metrics=metrics).setup()
     CullingReconciler(mgr, config, http_get=http_get, metrics=metrics).setup()
     SliceRepairController(mgr, config, http_get=http_get).setup()
+    if config.slo_enabled:
+        _wire_observability(mgr, config)
     return mgr
+
+
+def _wire_observability(mgr: Manager, config: Config) -> None:
+    """SLO engine -> alert manager -> flight recorder -> canary prober: the
+    judgement layer over the raw telemetry (ISSUE 5). All of it rides the
+    manager lifecycle (add_service) and the debug mux (/debug/slo,
+    /debug/incidents) finds it through the named manager attributes."""
+    from .runtime.alerts import AlertManager, default_rules
+    from .runtime.flightrecorder import recorder
+    from .runtime.slo import SLOEngine, default_slos
+    from .tpu import telemetry
+
+    slos = default_slos()
+    engine = SLOEngine(
+        registry=mgr.metrics,
+        slos=slos,
+        window_scale=config.slo_window_scale,
+        eval_period_s=config.slo_eval_period_s or None,
+    )
+    alert_mgr = AlertManager(
+        rules=default_rules(slos), manager=mgr, recorder=recorder
+    )
+    # THE inhibition contract (ARCHITECTURE.md): an active repair episode
+    # already explains degraded readiness — suppress the symptom alerts,
+    # keep the availability page live
+    alert_mgr.register_inhibitor(
+        "readiness",
+        lambda: telemetry.slice_repairs_in_progress.value() > 0,
+        name="slice-repair-in-progress",
+    )
+    engine.add_listener(alert_mgr.evaluate)
+    mgr.slo_engine = engine
+    mgr.alert_manager = alert_mgr
+    mgr.flight_recorder = recorder
+    mgr.add_service(engine)
+    if config.canary_period_s > 0:
+        from .runtime.prober import CanaryProber
+
+        prober = CanaryProber(
+            mgr,
+            period_s=config.canary_period_s,
+            timeout_s=config.canary_timeout_s,
+            namespace=config.canary_namespace,
+            accelerator=config.canary_accelerator,
+            topology=config.canary_topology,
+        )
+        mgr.prober = prober
+        mgr.add_service(prober)
 
 
 def serve_webhook(client, config: Config, cert_dir: str, port: int = 8443):
@@ -102,6 +152,11 @@ def main() -> None:  # pragma: no cover - thin CLI shell
         setup_json_logging(level=logging.INFO)
     else:
         logging.basicConfig(level=logging.INFO)
+    # warnings+ also land in the flight-recorder ring, so incident bundles
+    # carry the log lines around the failure
+    from .runtime.flightrecorder import recorder as _recorder
+
+    logging.getLogger().addHandler(_recorder.log_handler(level=logging.WARNING))
     config = Config.from_env()
     cluster = None
     webhook_server = None
@@ -174,6 +229,13 @@ def main() -> None:  # pragma: no cover - thin CLI shell
         from .cluster.sim import SimCluster
 
         cluster = SimCluster().start()
+        # somewhere for the CPU canary (and demo notebooks) to land
+        cluster.add_cpu_pool("default", nodes=2)
+        if config.canary_period_s <= 0 and "CANARY_PERIOD_S" not in os.environ:
+            # demo shape: the black-box canary is on by default against the
+            # sim — but an EXPLICIT CANARY_PERIOD_S=0 stays off (the env knob
+            # documents 0 as disabled; only the unset default is upgraded)
+            config.canary_period_s = 60.0
         mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
         log.info("tpu-notebook-controller running (in-process cluster)")
     # /metrics on :8080, /healthz + /readyz on :8081 (reference

@@ -22,6 +22,7 @@ seam between real JAX introspection and scripted test state.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -399,6 +400,11 @@ class NotebookAgent:
         self._serve_lock = racecheck.make_lock("NotebookAgent._serve_lock")
         self._closed = False
         self._last_port = 0
+        self._last_ready: Optional[bool] = None  # flight-recorder edge detect
+        # who this agent speaks for ("ns/pod"), stamped by whoever creates
+        # it (sim_agent_behavior; the standalone entrypoint uses HOSTNAME) —
+        # flight-recorder records are unattributable without it
+        self.identity = os.environ.get("HOSTNAME", "")
 
     def routes(self, path: str) -> Optional[Dict[str, Any]]:
         if self.base_path and path.startswith(self.base_path):
@@ -412,10 +418,24 @@ class NotebookAgent:
             visible = self.monitor.chips_visible()
             expected = self.monitor.chips_expected()
             ici_degraded = self.monitor.ici_degraded()
+            ready = expected > 0 and visible >= expected and not ici_degraded
+            if ready != self._last_ready:
+                # agent-side readiness edge into the flight-recorder ring
+                # (co-located in the sim; per-pod in a real deployment): the
+                # device view's OWN timeline, independent of what the probe
+                # gate concluded from it
+                self._last_ready = ready
+                from ..runtime.flightrecorder import recorder
+
+                recorder.record(
+                    "probe-agent", pod=self.identity, ready=ready,
+                    chips_visible=visible, chips_expected=expected,
+                    ici_degraded=ici_degraded,
+                )
             return {
                 "chips_visible": visible,
                 "chips_expected": expected,
-                "ready": expected > 0 and visible >= expected and not ici_degraded,
+                "ready": ready,
                 "process_id": self.monitor.process_id(),
                 # device-level health for the TPUHealthy condition
                 # (controllers/probe_status.py): dead chips + degraded ICI
@@ -568,6 +588,9 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
                 monitor=SimTPUMonitor(chips=visible, expected=n_chips, duty=duty),
                 kernels=kernels,
             )
+            # many agents share one process-wide flight-recorder ring in the
+            # sim: records must say whose device view they describe
+            agent.identity = f"{pod.metadata.namespace}/{pod.metadata.name}"
             agents[key] = agent
             agents[pod.metadata.name] = agent
         agent = agents[key]

@@ -5,11 +5,13 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from ..utils.logging import log_context
+from .flightrecorder import recorder
 from .metrics import (
     reconcile_duration_seconds,
     reconcile_errors_total,
@@ -82,6 +84,8 @@ class Controller:
             req = self.queue.get()
             if req is None:
                 return
+            t0 = time.perf_counter()
+            outcome = "error"
             try:
                 # log_context threads controller + object identity into every
                 # structured log record emitted below this frame
@@ -116,12 +120,21 @@ class Controller:
                 ):
                     self.queue.add_after(req, self.rate_limiter.when(req))
             finally:
+                # flight-recorder sample: one line per reconcile (controller,
+                # key, wall-clock, outcome, queue depth) — the incident
+                # bundle's answer to "what was the control plane doing"
+                recorder.record(
+                    "reconcile",
+                    controller=self.name,
+                    key=req.key,
+                    ms=round((time.perf_counter() - t0) * 1e3, 3),
+                    outcome=outcome,
+                    depth=len(self.queue),
+                )
                 self.queue.done(req)
 
     def wait_idle(self, timeout: float = 10.0, settle: float = 0.05) -> bool:
         """Test helper: wait until the queue is empty and stays empty briefly."""
-        import time
-
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if len(self.queue) == 0 and not self.queue._processing:

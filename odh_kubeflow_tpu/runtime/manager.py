@@ -196,6 +196,14 @@ class Manager:
         registry.add_collector(_collect_cache_age)
         self.controllers: List[Controller] = []
         self._runnables: List[Callable[[], None]] = []  # extra start hooks
+        # observability services (SLO engine, alert manager, canary prober,
+        # flight recorder): started/stopped with the manager and exposed by
+        # name so the debug mux (runtime/serving.py) can serve their state
+        self._services: List = []  # objects with start()/stop()
+        self.slo_engine = None
+        self.alert_manager = None
+        self.prober = None
+        self.flight_recorder = None
         self._started = False
         self.elector: Optional[LeaderElector] = None
         if leader_election:
@@ -236,6 +244,13 @@ class Manager:
     def add_runnable(self, fn: Callable[[], None]) -> None:
         self._runnables.append(fn)
 
+    def add_service(self, service) -> None:
+        """Register a start()/stop() service tied to the manager lifecycle
+        (the SLO engine's evaluation loop, the canary prober)."""
+        self._services.append(service)
+        if self._started:
+            service.start()
+
     def start(self, wait_for_leadership_timeout: Optional[float] = None) -> None:
         """With leader election, blocks until leadership is acquired —
         indefinitely by default, as controller-runtime does: during a rolling
@@ -258,9 +273,16 @@ class Manager:
             ctrl.start()
         for fn in self._runnables:
             fn()
+        for service in self._services:
+            service.start()
         self._started = True
 
     def stop(self) -> None:
+        for service in self._services:
+            try:
+                service.stop()
+            except Exception:
+                log.exception("stopping %r failed", service)
         for ctrl in self.controllers:
             ctrl.stop()
         self.informers.stop_all()

@@ -44,6 +44,31 @@ class _Metric:
         )
         return "{" + pairs + "}"
 
+    def series(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every (labels, value) pair of the family — the SLO engine's read
+        surface (counters/gauges; histograms expose cumulative_le instead)."""
+        with self._lock:
+            return [
+                (dict(zip(self.label_names, k)), v)
+                for k, v in self._values.items()
+            ]
+
+    def sum_matching(self, labels: Dict[str, str]) -> float:
+        """Sum of series whose labels include every given (name, value) pair
+        ({} sums the whole family) — e.g. good events
+        canary_probes_total{result="ok"} vs the family total."""
+        positions = [
+            (i, labels[name])
+            for i, name in enumerate(self.label_names)
+            if name in labels
+        ]
+        with self._lock:
+            return sum(
+                v
+                for k, v in self._values.items()
+                if all(k[i] == want for i, want in positions)
+            )
+
 
 class Counter(_Metric):
     type_name = "counter"
@@ -128,6 +153,24 @@ class Histogram(_Metric):
     def time(self, **labels: str) -> _HistogramTimer:
         return _HistogramTimer(self, labels)
 
+    def cumulative_le(self, le: float) -> Tuple[float, float]:
+        """(observations <= le, total observations) across every label set —
+        the latency-SLO read: good events are the ones at or under the
+        threshold bucket. `le` should sit on a bucket boundary (enforced by
+        ci/slo_lint.sh); between boundaries the next bucket up answers."""
+        idx = None
+        for i, b in enumerate(self.buckets):
+            if le <= b:
+                idx = i
+                break
+        with self._lock:
+            good = 0.0
+            total = 0.0
+            for k, counts in self._counts.items():
+                good += counts[idx] if idx is not None else self._totals.get(k, 0)
+                total += self._totals.get(k, 0)
+        return good, total
+
     def percentile(self, p: float, **labels: str) -> Optional[float]:
         """Approximate percentile from bucket counts (upper bound of the bucket)."""
         with self._lock:
@@ -187,12 +230,24 @@ class Registry:
             except ValueError:
                 pass
 
-    def render(self) -> str:
+    def get(self, name: str) -> Optional[_Metric]:
+        """Registered family by name (the SLO engine resolves declarative
+        indicator references through this)."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def run_collectors(self) -> None:
+        """Run pull-style collectors outside a render — the SLO engine ticks
+        these so gauge-backed indicators see fresh values between scrapes."""
         with self._lock:
             collectors = list(self._collectors)
-            metrics = list(self._metrics.values())
         for fn in collectors:
             fn()
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        self.run_collectors()
         lines: List[str] = []
         for m in metrics:
             lines.append(f"# HELP {m.name} {escape_help(m.help)}")
@@ -319,4 +374,20 @@ informer_cache_sync_age_seconds = global_registry.gauge(
     "Seconds since the informer cache last (re)synced, by kind (set at scrape "
     "by the manager's collector)",
     labels=("kind",),
+)
+
+# ---- trace root-registry accounting (ISSUE 5 satellite): synthesized
+# cross-process roots that never close used to age out only via silent
+# eviction; utils/tracing.py now closes them on notebook deletion and keeps
+# the leak visible through these series ----
+
+tracing_roots_active = global_registry.gauge(
+    "tracing_roots_active",
+    "Open long-lived trace roots (notebook.ready envelopes not yet closed)",
+)
+tracing_roots_evicted_total = global_registry.counter(
+    "tracing_roots_evicted_total",
+    "Open trace roots dropped without finishing, by reason (capacity | "
+    "reopened | deleted | discarded)",
+    labels=("reason",),
 )

@@ -42,32 +42,84 @@ class ServingEndpoints:
                 pass
 
             def do_GET(self):
+                import json
+
                 from urllib.parse import parse_qs, urlparse
 
                 parsed = urlparse(self.path)
                 path = parsed.path
+                query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+
+                def respond_json(payload, code: int = 200) -> None:
+                    serving._respond(
+                        self, code, json.dumps(payload).encode(),
+                        content_type="application/json",
+                    )
+
                 if path == "/metrics":
                     body = registry.render().encode()
                     serving._respond(
                         self, 200, body, content_type="text/plain; version=0.0.4"
                     )
+                elif path in ("/debug", "/debug/"):
+                    # tiny index so a responder lands somewhere navigable
+                    serving._respond(
+                        self, 200, serving._index_page(), content_type="text/html"
+                    )
                 elif path == "/debug/traces":
                     # recent completed spans as JSON; ?trace_id= narrows to
-                    # one trace (e.g. a notebook's readiness decomposition)
-                    import json
-
+                    # one trace (a notebook's readiness decomposition),
+                    # ?notebook= to one notebook's spans, ?limit= to the
+                    # newest N (the full ring is thousands of spans)
                     from ..utils import tracing
 
-                    query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
                     spans = tracing.recent_spans(
                         trace_id=query.get("trace_id"), name=query.get("name")
                     )
-                    serving._respond(
-                        self,
-                        200,
-                        json.dumps({"spans": spans}).encode(),
-                        content_type="application/json",
-                    )
+                    notebook = query.get("notebook")
+                    if notebook:
+                        # controller spans carry notebook=<bare name> with
+                        # namespace separate; accept both that and the
+                        # "ns/name" form the docs use
+                        def matches(attrs: dict) -> bool:
+                            name = attrs.get("notebook")
+                            if name == notebook:
+                                return True
+                            return (
+                                name is not None
+                                and f"{attrs.get('namespace', '')}/{name}"
+                                == notebook
+                            )
+
+                        spans = [s for s in spans if matches(s["attributes"])]
+                    if "limit" in query:
+                        try:
+                            limit = int(query["limit"])
+                        except ValueError:
+                            respond_json({"error": "limit must be an integer"}, 400)
+                            return
+                        if limit < 0:
+                            respond_json({"error": "limit must be >= 0"}, 400)
+                            return
+                        spans = spans[-limit:] if limit else []
+                    respond_json({"spans": spans})
+                elif path == "/debug/slo":
+                    engine = getattr(serving.manager, "slo_engine", None)
+                    alert_mgr = getattr(serving.manager, "alert_manager", None)
+                    respond_json({
+                        "engine": engine.status() if engine is not None else None,
+                        "alerts": alert_mgr.status() if alert_mgr is not None else None,
+                    })
+                elif path == "/debug/incidents":
+                    rec = serving._recorder()
+                    if "id" in query:
+                        bundle = rec.get(query["id"])
+                        if bundle is None:
+                            respond_json({"error": f"no incident {query['id']}"}, 404)
+                        else:
+                            respond_json(bundle)
+                    else:
+                        respond_json({"incidents": rec.incidents()})
                 elif path == "/healthz":
                     # mirrored here so one port serves the whole debug mux
                     ok = serving.manager.healthz()
@@ -102,6 +154,33 @@ class ServingEndpoints:
     def _respond(h: BaseHTTPRequestHandler, code: int, body: bytes,
                  content_type: str = "text/plain") -> None:
         respond(h, code, body, content_type)
+
+    def _recorder(self):
+        """The manager's wired flight recorder, falling back to the
+        process-wide one (slice repair feeds that even without full SLO
+        wiring)."""
+        rec = getattr(self.manager, "flight_recorder", None)
+        if rec is not None:
+            return rec
+        from .flightrecorder import recorder
+
+        return recorder
+
+    @staticmethod
+    def _index_page() -> bytes:
+        return (
+            b"<html><head><title>tpu-notebook-operator debug</title></head>"
+            b"<body><h1>tpu-notebook-operator</h1><ul>"
+            b'<li><a href="/metrics">/metrics</a> &mdash; Prometheus exposition</li>'
+            b'<li><a href="/debug/traces?limit=100">/debug/traces</a> &mdash; '
+            b"recent spans (?trace_id=, ?notebook=, ?name=, ?limit=)</li>"
+            b'<li><a href="/debug/slo">/debug/slo</a> &mdash; SLO compliance, '
+            b"burn rates, alert state</li>"
+            b'<li><a href="/debug/incidents">/debug/incidents</a> &mdash; '
+            b"flight-recorder incident bundles (?id=)</li>"
+            b'<li><a href="/healthz">/healthz</a></li>'
+            b"</ul></body></html>\n"
+        )
 
     @property
     def metrics_address(self) -> Tuple[str, int]:

@@ -1,0 +1,388 @@
+"""SLO engine: declarative objectives over the live metrics registry.
+
+PR 2 gave the operator raw telemetry and PR 4 added repair MTTR/interruption
+counters, but nothing *judged* those signals. This module turns them into
+objectives the way Google SRE workbook ch.5 prescribes:
+
+- an `SLO` is declarative: a name, a target objective (0 < objective < 1),
+  and an indicator that maps live registry series to cumulative
+  (good_events, total_events) — a latency histogram with a threshold bucket,
+  a good/total event-counter ratio, or a 0..1 ratio gauge integrated over
+  time (availability/goodput),
+- the engine samples every SLO on a fixed cadence and keeps a bounded
+  history of cumulative snapshots, so windowed compliance is a two-sample
+  delta — no per-event storage,
+- burn rate per window = (1 - compliance(window)) / error_budget, evaluated
+  over the standard multi-window pairs (5m/1h fast page, 30m/6h slow
+  ticket; runtime/alerts.py owns the pairing and lifecycle),
+- compliance/burn are exported as `slo_compliance_ratio{slo}` and
+  `slo_burn_rate{slo,window}` gauges and served as JSON at `/debug/slo`.
+
+Sim-clock aware: `clock` is injectable and every canonical window is scaled
+by `window_scale`, so a seeded bad-day soak exercises the real 5m/1h/6h
+rule shapes in seconds, deterministically — window *names* stay canonical
+("5m", "1h") no matter the scale, so alert rules and dashboards read the
+same in tests and production.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
+
+import time
+
+from ..utils import racecheck
+from .metrics import Gauge, Histogram, Registry, global_registry
+
+log = logging.getLogger(__name__)
+
+# canonical multi-burn-rate windows (Google SRE workbook ch.5): the fast
+# pair pages, the slow pair tickets. Seconds at window_scale=1.0.
+WINDOWS: Tuple[Tuple[str, float], ...] = (
+    ("5m", 300.0),
+    ("30m", 1800.0),
+    ("1h", 3600.0),
+    ("6h", 21600.0),
+)
+WINDOW_SECONDS: Dict[str, float] = dict(WINDOWS)
+
+slo_compliance_ratio = global_registry.gauge(
+    "slo_compliance_ratio",
+    "Fraction of good events over the longest burn window, by SLO "
+    "(1.0 = fully within objective)",
+    labels=("slo",),
+)
+slo_burn_rate = global_registry.gauge(
+    "slo_burn_rate",
+    "Error-budget burn rate by SLO and window (1.0 = burning exactly the "
+    "budget; the 5m/1h pair pages at 14.4x, the 30m/6h pair tickets at 6x)",
+    labels=("slo", "window"),
+)
+slo_evaluations_total = global_registry.counter(
+    "slo_evaluations_total",
+    "SLO engine evaluation ticks completed",
+)
+
+
+# ---------------------------------------------------------------------------
+# indicators: live registry series -> cumulative (good, total)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LatencyIndicator:
+    """Good = observations at or under `threshold_s` of a histogram family
+    (the threshold must sit on a bucket boundary — ci/slo_lint.sh enforces
+    it, because between-bucket thresholds silently round)."""
+
+    histogram: str
+    threshold_s: float
+
+    def metric_names(self) -> Tuple[str, ...]:
+        return (self.histogram,)
+
+    def cumulative(self, registry: Registry) -> Optional[Tuple[float, float]]:
+        metric = registry.get(self.histogram)
+        if not isinstance(metric, Histogram):
+            return None
+        return metric.cumulative_le(self.threshold_s)
+
+
+@dataclass(frozen=True)
+class EventRatioIndicator:
+    """Good = counter series matching `good_labels`; total = every series of
+    the family (e.g. canary_probes_total{result="ok"} over all results)."""
+
+    counter: str
+    good_labels: Tuple[Tuple[str, str], ...] = ()
+
+    def metric_names(self) -> Tuple[str, ...]:
+        return (self.counter,)
+
+    def cumulative(self, registry: Registry) -> Optional[Tuple[float, float]]:
+        metric = registry.get(self.counter)
+        if metric is None or isinstance(metric, Histogram):
+            return None
+        good = metric.sum_matching(dict(self.good_labels))
+        total = metric.sum_matching({})
+        return good, total
+
+
+@dataclass(frozen=True)
+class GaugeIndicator:
+    """A 0..1 ratio gauge (availability, goodput) integrated over wall time:
+    each engine tick contributes dt of "total" and value*dt of "good", so
+    windowed compliance is the time-weighted mean of the gauge. Ticks before
+    the gauge has ever been set contribute nothing (a fleet with no TPU
+    notebooks must not read as 0% available)."""
+
+    gauge: str
+
+    def metric_names(self) -> Tuple[str, ...]:
+        return (self.gauge,)
+
+    def value(self, registry: Registry) -> Optional[float]:
+        metric = registry.get(self.gauge)
+        if not isinstance(metric, Gauge) or not metric.series():
+            return None
+        return max(0.0, min(1.0, metric.value()))
+
+
+@dataclass(frozen=True)
+class SLO:
+    """One declarative objective. `category` keys alert inhibition
+    (runtime/alerts.py): slice-repair-in-progress inhibits the "readiness"
+    category, never "availability" (see ARCHITECTURE.md)."""
+
+    name: str
+    objective: float  # target good/total fraction, 0 < objective < 1
+    indicator: object  # Latency | EventRatio | Gauge indicator
+    description: str = ""
+    category: str = "readiness"
+
+    @property
+    def error_budget(self) -> float:
+        return max(1e-9, 1.0 - self.objective)
+
+    def metric_names(self) -> Tuple[str, ...]:
+        return self.indicator.metric_names()
+
+
+def default_slos() -> Tuple[SLO, ...]:
+    """The operator's shipped objectives over series that PR 2/PR 4 already
+    emit (ci/slo_lint.sh checks every referenced family exists)."""
+    return (
+        SLO(
+            "readiness-latency-p50",
+            objective=0.50,
+            indicator=LatencyIndicator("notebook_slice_ready_seconds", 30.0),
+            description="half of slice bring-ups reach jax.devices() ready "
+            "within 30s (the north-star p50)",
+            category="readiness",
+        ),
+        SLO(
+            "readiness-latency-p99",
+            objective=0.99,
+            indicator=LatencyIndicator("notebook_slice_ready_seconds", 300.0),
+            description="99% of slice bring-ups ready within 300s",
+            category="readiness",
+        ),
+        SLO(
+            "canary-readiness",
+            objective=0.99,
+            indicator=EventRatioIndicator(
+                "canary_probes_total", good_labels=(("result", "ok"),)
+            ),
+            description="99% of black-box canary probes complete the full "
+            "admission->schedule->probe->ready path",
+            category="readiness",
+        ),
+        SLO(
+            "notebook-availability",
+            objective=0.999,
+            indicator=GaugeIndicator("notebook_available_ratio"),
+            description="previously-ready TPU notebooks stay mesh-ready "
+            "(time-weighted)",
+            category="availability",
+        ),
+        SLO(
+            "repair-mttr",
+            objective=0.90,
+            indicator=LatencyIndicator("tpu_slice_repair_duration_seconds", 60.0),
+            description="90% of slice repairs complete within 60s",
+            category="repair",
+        ),
+        SLO(
+            "goodput",
+            objective=0.98,
+            indicator=GaugeIndicator("tpu_slice_goodput_ratio"),
+            description="the fleet spends >= 98% of tracked slice-lifetime "
+            "Ready rather than Degraded/Repairing",
+            category="goodput",
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _SLOState:
+    samples: Deque[Tuple[float, float, float]] = field(default_factory=deque)
+    # GaugeIndicator integration accumulators
+    integ_good: float = 0.0
+    integ_total: float = 0.0
+    last_t: Optional[float] = None
+
+
+class SLOEngine:
+    """Samples every SLO on a cadence, exports compliance/burn gauges, and
+    fans each tick's statuses out to listeners (the AlertManager)."""
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        slos: Sequence[SLO] = (),
+        clock: Callable[[], float] = time.time,
+        window_scale: float = 1.0,
+        eval_period_s: Optional[float] = None,
+    ):
+        self.registry = registry or global_registry
+        self.slos: Tuple[SLO, ...] = tuple(slos) or default_slos()
+        self.clock = clock
+        self.window_scale = window_scale
+        self.windows: Dict[str, float] = {
+            name: seconds * window_scale for name, seconds in WINDOWS
+        }
+        # ~20 samples per shortest window keeps the two-sample delta honest
+        # without the cadence itself becoming load
+        self.eval_period_s = eval_period_s or max(
+            0.05, min(15.0, self.windows["5m"] / 20.0)
+        )
+        self._retention_s = max(self.windows.values()) * 1.25 + self.eval_period_s * 4
+        # collectors (pull-style scrapers, e.g. NotebookMetrics' cluster
+        # listing) only need to run when a gauge-backed indicator reads
+        # their output; histogram/counter indicators are push-updated, so an
+        # event-only SLO set must not pay a cluster listing per tick
+        self._needs_collectors = any(
+            isinstance(s.indicator, GaugeIndicator) for s in self.slos
+        )
+        self._state: Dict[str, _SLOState] = {s.name: _SLOState() for s in self.slos}
+        self._listeners: List[Callable[[Dict[str, dict]], None]] = []
+        self._last_status: Dict[str, dict] = {}
+        self._lock = racecheck.make_lock("SLOEngine._lock")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring --
+
+    def add_listener(self, fn: Callable[[Dict[str, dict]], None]) -> None:
+        self._listeners.append(fn)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="slo-engine"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.eval_period_s):
+            try:
+                self.evaluate()
+            except Exception:
+                # one bad tick must not kill the evaluation loop
+                log.exception("slo evaluation tick failed")
+
+    # -- evaluation --
+
+    def evaluate(self) -> Dict[str, dict]:
+        """One tick: pull collectors so gauge-backed indicators see fresh
+        values, sample every SLO, export gauges, notify listeners."""
+        now = self.clock()
+        if self._needs_collectors:
+            self.registry.run_collectors()
+        statuses: Dict[str, dict] = {}
+        with self._lock:
+            for slo in self.slos:
+                statuses[slo.name] = self._evaluate_one(slo, now)
+            self._last_status = statuses
+        slo_evaluations_total.inc()
+        for fn in list(self._listeners):
+            try:
+                fn(statuses)
+            except Exception:
+                log.exception("slo listener failed")
+        return statuses
+
+    def _evaluate_one(self, slo: SLO, now: float) -> dict:
+        state = self._state[slo.name]
+        indicator = slo.indicator
+        if isinstance(indicator, GaugeIndicator):
+            value = indicator.value(self.registry)
+            if value is not None:
+                dt = 0.0 if state.last_t is None else max(0.0, now - state.last_t)
+                state.integ_good += value * dt
+                state.integ_total += dt
+                state.last_t = now
+            cumulative: Optional[Tuple[float, float]] = (
+                state.integ_good,
+                state.integ_total,
+            )
+        else:
+            cumulative = indicator.cumulative(self.registry)
+        if cumulative is None:
+            cumulative = (0.0, 0.0)
+        state.samples.append((now, cumulative[0], cumulative[1]))
+        while state.samples and state.samples[0][0] < now - self._retention_s:
+            state.samples.popleft()
+
+        windows: Dict[str, dict] = {}
+        for name, seconds in self.windows.items():
+            compliance = self._windowed_compliance(state.samples, now, seconds)
+            burn = (1.0 - compliance) / slo.error_budget
+            windows[name] = {
+                "compliance": round(compliance, 6),
+                "burn_rate": round(burn, 4),
+            }
+            slo_burn_rate.set(burn, slo=slo.name, window=name)
+        longest = max(self.windows, key=lambda n: self.windows[n])
+        slo_compliance_ratio.set(windows[longest]["compliance"], slo=slo.name)
+        return {
+            "objective": slo.objective,
+            "category": slo.category,
+            "description": slo.description,
+            "compliance": windows[longest]["compliance"],
+            "windows": windows,
+            "events": {"good": cumulative[0], "total": cumulative[1]},
+        }
+
+    @staticmethod
+    def _windowed_compliance(
+        samples: Deque[Tuple[float, float, float]], now: float, window_s: float
+    ) -> float:
+        """good/total delta between the newest sample and the newest sample
+        at or before the window start (falling back to the oldest — a young
+        engine judges over the history it has). No events in the window =
+        compliant: an idle fleet burns no budget."""
+        if not samples:
+            return 1.0
+        newest = samples[-1]
+        cutoff = now - window_s
+        baseline = samples[0]
+        for sample in samples:
+            if sample[0] <= cutoff:
+                baseline = sample
+            else:
+                break
+        good = newest[1] - baseline[1]
+        total = newest[2] - baseline[2]
+        if total <= 0:
+            return 1.0
+        return max(0.0, min(1.0, good / total))
+
+    # -- introspection (/debug/slo) --
+
+    def status(self) -> dict:
+        with self._lock:
+            slos = dict(self._last_status)
+        return {
+            "window_scale": self.window_scale,
+            "eval_period_s": self.eval_period_s,
+            "windows_s": dict(self.windows),
+            "slos": slos,
+        }

@@ -81,6 +81,12 @@ slice_goodput_ratio = global_registry.gauge(
     "Cumulative fraction of tracked slice-lifetime spent Ready rather than "
     "Degraded/Repairing (1.0 = no interruption downtime observed)",
 )
+slice_repairs_in_progress = global_registry.gauge(
+    "tpu_slice_repairs_in_progress",
+    "Notebooks currently inside a repair episode (any repair state). The "
+    "alert manager's slice-repair inhibitor keys off this: readiness-"
+    "category burn alerts are suppressed while > 0 (ARCHITECTURE.md)",
+)
 
 
 class GoodputAccounting:

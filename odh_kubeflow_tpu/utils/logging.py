@@ -40,25 +40,34 @@ def log_context(**fields: Any) -> Iterator[None]:
         _local.fields = prev
 
 
+def record_fields(record: logging.LogRecord) -> Dict[str, Any]:
+    """One log record as structured fields: timestamp/level/message plus the
+    ambient log context and current trace/span ids. Shared by the JSON
+    formatter and the flight recorder's log capture, so an incident bundle's
+    log lines carry exactly what the emitted JSON logs carried."""
+    out: Dict[str, Any] = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
+        + f".{int(record.msecs):03d}Z",
+        "level": record.levelname,
+        "logger": record.name,
+        "message": record.getMessage(),
+    }
+    out.update(getattr(_local, "fields", None) or {})
+    # trace correlation: inject the ids of whatever span is current on
+    # this thread (deferred import: logging must work during partial
+    # interpreter teardown and never cycle back through utils.tracing)
+    from .tracing import current_span
+
+    span = current_span()
+    if span is not None and span.trace_id:
+        out["trace_id"] = span.trace_id
+        out["span_id"] = span.span_id
+    return out
+
+
 class JSONLogFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
-        out: Dict[str, Any] = {
-            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(record.created))
-            + f".{int(record.msecs):03d}Z",
-            "level": record.levelname,
-            "logger": record.name,
-            "message": record.getMessage(),
-        }
-        out.update(getattr(_local, "fields", None) or {})
-        # trace correlation: inject the ids of whatever span is current on
-        # this thread (deferred import: logging must work during partial
-        # interpreter teardown and never cycle back through utils.tracing)
-        from .tracing import current_span
-
-        span = current_span()
-        if span is not None and span.trace_id:
-            out["trace_id"] = span.trace_id
-            out["span_id"] = span.span_id
+        out = record_fields(record)
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
         return json.dumps(out, default=str)

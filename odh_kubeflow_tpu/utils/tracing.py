@@ -201,6 +201,30 @@ class TraceBuffer:
 
 global_buffer = TraceBuffer()
 
+# completed-span listeners (the flight recorder subscribes): called once per
+# exported span, after it lands in the buffer, outside any tracing lock
+_span_listeners: List[Any] = []
+
+
+def add_span_listener(fn) -> None:
+    _span_listeners.append(fn)
+
+
+def remove_span_listener(fn) -> None:
+    try:
+        _span_listeners.remove(fn)
+    except ValueError:
+        pass
+
+
+def _export(span: Span) -> None:
+    global_buffer.append(span)
+    for fn in list(_span_listeners):
+        try:
+            fn(span)
+        except Exception:
+            pass  # a broken listener must never break the traced code path
+
 
 def recent_spans(trace_id: Optional[str] = None, name: Optional[str] = None) -> List[dict]:
     """Completed spans as JSON-ready dicts (newest last) — the /debug/traces
@@ -214,6 +238,7 @@ def clear() -> None:
         _open_roots.clear()
         _root_id_by_key.clear()
         _key_by_root_id.clear()
+    _publish_root_stats(0)
 
 
 # ---------------------------------------------------------------------------
@@ -272,7 +297,7 @@ class Tracer:
     def _record(self, span: Span) -> None:
         if not span.recording:
             return
-        global_buffer.append(span)
+        _export(span)
         if self.exporter is not None:
             self.exporter.spans.append(span)
 
@@ -358,7 +383,7 @@ def record_span(
         start_time=start_time if start_time is not None else now,
         end_time=end_time if end_time is not None else now,
     )
-    global_buffer.append(span)
+    _export(span)
     return span
 
 
@@ -383,6 +408,20 @@ def _drop_root_locked(trace_id: str) -> Optional[Span]:
     return span
 
 
+def _publish_root_stats(active: int, evicted_reason: Optional[str] = None) -> None:
+    """Mirror the root registry into tracing_roots_active /
+    tracing_roots_evicted_total (runtime/metrics.py) so a leak is visible on
+    /metrics instead of silently aging out. Deferred import + never under
+    _roots_lock: metrics must not become part of tracing's lock order."""
+    try:
+        from ..runtime import metrics as _rm
+    except Exception:  # pragma: no cover - partial interpreter teardown
+        return
+    _rm.tracing_roots_active.set(float(active))
+    if evicted_reason is not None:
+        _rm.tracing_roots_evicted_total.inc(reason=evicted_reason)
+
+
 def begin_root(name: str, key: Optional[str] = None, **attributes: Any) -> Optional[Span]:
     """Open a root span that outlives any one call stack (the webhook opens
     `notebook.ready` here at CREATE admission; the probe-status gate closes
@@ -398,16 +437,26 @@ def begin_root(name: str, key: Optional[str] = None, **attributes: Any) -> Optio
         attributes=dict(attributes),
         start_time=time.time(),
     )
+    reopened = evicted = 0
     with _roots_lock:
         if key is not None:
             stale = _root_id_by_key.get(key)
             if stale is not None:
                 _drop_root_locked(stale)
+                reopened += 1
             _root_id_by_key[key] = span.trace_id
             _key_by_root_id[span.trace_id] = key
         while len(_open_roots) >= _MAX_OPEN_ROOTS:
             _drop_root_locked(next(iter(_open_roots)))  # insertion order = oldest
+            evicted += 1
         _open_roots[span.trace_id] = span
+        active = len(_open_roots)
+    for _ in range(reopened):
+        _publish_root_stats(active, "reopened")
+    for _ in range(evicted):
+        _publish_root_stats(active, "capacity")
+    if not reopened and not evicted:
+        _publish_root_stats(active)
     return span
 
 
@@ -417,11 +466,13 @@ def finish_root(trace_id: str, end_time: Optional[float] = None, **attributes: A
     record_span with the annotation's ids)."""
     with _roots_lock:
         span = _drop_root_locked(trace_id)
+        active = len(_open_roots)
     if span is None:
         return None
+    _publish_root_stats(active)
     span.attributes.update(attributes)
     span.end_time = end_time if end_time is not None else time.time()
-    global_buffer.append(span)
+    _export(span)
     return span
 
 
@@ -435,7 +486,23 @@ def discard_root(trace_id: str) -> None:
     webhook opened the root must not leak the entry, nor record a phantom
     readiness trace)."""
     with _roots_lock:
-        _drop_root_locked(trace_id)
+        span = _drop_root_locked(trace_id)
+        active = len(_open_roots)
+    _publish_root_stats(active, "discarded" if span is not None else None)
+
+
+def discard_root_for(key: str) -> Optional[Span]:
+    """Drop the open root registered under a dedup key ("ns/name") — the
+    notebook reconciler calls this when the owning CR is deleted, so a
+    notebook that never reached ready closes its root deterministically
+    instead of leaking until capacity eviction. Returns the dropped span
+    (None when no root was open for the key)."""
+    with _roots_lock:
+        trace_id = _root_id_by_key.get(key)
+        span = _drop_root_locked(trace_id) if trace_id is not None else None
+        active = len(_open_roots)
+    _publish_root_stats(active, "deleted" if span is not None else None)
+    return span
 
 
 class InMemoryExporter:

@@ -1,0 +1,724 @@
+"""SLO engine / burn-rate alerting / black-box prober / flight recorder
+(ISSUE 5 tentpole).
+
+Unit halves run against a fake clock (deterministic burn-rate math, alert
+lifecycle, inhibition, ring/bundle semantics); the acceptance soak at the
+bottom runs the seeded slice bad day with the full judgement layer wired:
+a burn-rate alert fires within the fast window, is mirrored as an Event +
+`DegradedSLO` condition on the affected Notebook, resolves after repair
+completes, and the flight recorder produces exactly the expected incident
+bundles, retrievable via /debug/incidents. The calm-path overhead test
+bounds the whole layer at <10% added per-reconcile cost.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from odh_kubeflow_tpu.runtime.alerts import AlertManager, AlertRule, default_rules
+from odh_kubeflow_tpu.runtime.flightrecorder import FlightRecorder, recorder
+from odh_kubeflow_tpu.runtime.metrics import Registry
+from odh_kubeflow_tpu.runtime.slo import (
+    SLO,
+    EventRatioIndicator,
+    GaugeIndicator,
+    LatencyIndicator,
+    SLOEngine,
+    default_slos,
+)
+from odh_kubeflow_tpu.utils import tracing
+
+pytestmark = pytest.mark.slo
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (fake clock, private registry)
+# ---------------------------------------------------------------------------
+
+
+def _mk_engine(reg, slos, t):
+    return SLOEngine(
+        registry=reg, slos=slos, clock=lambda: t[0], window_scale=1.0,
+        eval_period_s=1.0,
+    )
+
+
+def test_latency_slo_windowed_compliance_and_burn():
+    reg = Registry()
+    hist = reg.histogram("lat_seconds", "h", buckets=(1, 5, 10))
+    slo = SLO("lat", objective=0.9, indicator=LatencyIndicator("lat_seconds", 1.0))
+    t = [1000.0]
+    eng = _mk_engine(reg, [slo], t)
+    eng.evaluate()  # baseline sample before any events
+
+    for _ in range(9):
+        hist.observe(0.5)
+    hist.observe(7.0)
+    t[0] += 10
+    status = eng.evaluate()["lat"]
+    w = status["windows"]["5m"]
+    # 9 good / 10 total against a 10% budget: burning exactly the budget
+    assert w["compliance"] == pytest.approx(0.9)
+    assert w["burn_rate"] == pytest.approx(1.0)
+
+    # a burst of pure failures: 5m window now sees 9 good / 20 total
+    t[0] += 60
+    for _ in range(10):
+        hist.observe(7.0)
+    status = eng.evaluate()["lat"]
+    assert status["windows"]["5m"]["compliance"] == pytest.approx(9 / 20)
+    assert status["windows"]["5m"]["burn_rate"] == pytest.approx(
+        (1 - 9 / 20) / 0.1
+    )
+
+    # the outage ages out of the fast window but stays in the slow one
+    t[0] += 301
+    status = eng.evaluate()["lat"]
+    assert status["windows"]["5m"]["compliance"] == 1.0  # no events in window
+    assert status["windows"]["5m"]["burn_rate"] == 0.0
+    assert status["windows"]["6h"]["compliance"] == pytest.approx(9 / 20)
+
+
+def test_event_ratio_indicator_good_labels():
+    reg = Registry()
+    probes = reg.counter("probes_total", "p", labels=("result",))
+    slo = SLO(
+        "canary", objective=0.9,
+        indicator=EventRatioIndicator("probes_total", (("result", "ok"),)),
+    )
+    t = [0.0]
+    eng = _mk_engine(reg, [slo], t)
+    eng.evaluate()
+    probes.inc(8, result="ok")
+    probes.inc(2, result="timeout")
+    t[0] += 10
+    status = eng.evaluate()["canary"]
+    assert status["windows"]["5m"]["compliance"] == pytest.approx(0.8)
+    assert status["windows"]["5m"]["burn_rate"] == pytest.approx(2.0)
+
+
+def test_gauge_indicator_time_weighted_and_unset_gauge_is_compliant():
+    reg = Registry()
+    avail = reg.gauge("avail_ratio", "a")
+    slo = SLO("avail", objective=0.99, indicator=GaugeIndicator("avail_ratio"))
+    t = [0.0]
+    eng = _mk_engine(reg, [slo], t)
+    # gauge never set: no burn (a fleet with nothing to measure is healthy)
+    status = eng.evaluate()["avail"]
+    assert status["windows"]["5m"]["compliance"] == 1.0
+
+    avail.set(1.0)
+    eng.evaluate()  # integration anchor
+    t[0] += 10
+    eng.evaluate()  # 10s at 1.0
+    avail.set(0.5)
+    t[0] += 10
+    status = eng.evaluate()["avail"]  # +10s at 0.5
+    assert status["windows"]["5m"]["compliance"] == pytest.approx(0.75)
+    assert status["windows"]["5m"]["burn_rate"] == pytest.approx(0.25 / 0.01)
+
+
+def test_window_scale_shrinks_real_windows_keeps_names():
+    eng = SLOEngine(registry=Registry(), slos=default_slos(), window_scale=0.002)
+    assert eng.windows["5m"] == pytest.approx(0.6)
+    assert eng.windows["6h"] == pytest.approx(43.2)
+    assert set(eng.windows) == {"5m", "30m", "1h", "6h"}
+
+
+# ---------------------------------------------------------------------------
+# alert lifecycle: fire / dedup / resolve / inhibition
+# ---------------------------------------------------------------------------
+
+
+def _status(category="readiness", burn_long=0.0, burn_short=0.0):
+    return {
+        "s": {
+            "category": category,
+            "windows": {
+                "1h": {"burn_rate": burn_long, "compliance": 1.0},
+                "5m": {"burn_rate": burn_short, "compliance": 1.0},
+            },
+        }
+    }
+
+
+def test_alert_fires_dedups_and_resolves_on_long_window():
+    t = [100.0]
+    rule = AlertRule("s-fast-burn", "s", "1h", "5m", 14.4)
+    am = AlertManager(rules=[rule], clock=lambda: t[0])
+
+    # short window alone must NOT fire (outage already over / just starting)
+    am.evaluate(_status(burn_long=1.0, burn_short=99.0))
+    assert not am.firing
+    am.evaluate(_status(burn_long=99.0, burn_short=1.0))
+    assert not am.firing
+
+    am.evaluate(_status(burn_long=20.0, burn_short=20.0))
+    assert "s-fast-burn" in am.firing
+    fired = [h for h in am.history if h["event"] == "fired"]
+    assert len(fired) == 1
+
+    # still breaching: deduplicated, not re-fired
+    t[0] += 5
+    am.evaluate(_status(burn_long=21.0, burn_short=21.0))
+    assert len([h for h in am.history if h["event"] == "fired"]) == 1
+
+    # short window recovers first: still firing (resolve keys off long only)
+    am.evaluate(_status(burn_long=20.0, burn_short=0.5))
+    assert "s-fast-burn" in am.firing
+
+    t[0] += 5
+    am.evaluate(_status(burn_long=2.0, burn_short=0.5))
+    assert not am.firing
+    resolved = [h for h in am.history if h["event"] == "resolved"]
+    assert len(resolved) == 1
+    assert resolved[0]["resolved_at"] - resolved[0]["since"] == pytest.approx(10.0)
+
+
+def test_slice_repair_inhibits_readiness_but_not_availability():
+    repair_active = [True]
+    rules = [
+        AlertRule("ready-fast", "s", "1h", "5m", 14.4),
+    ]
+    am = AlertManager(rules=rules, clock=lambda: 0.0)
+    am.register_inhibitor(
+        "readiness", lambda: repair_active[0], name="slice-repair-in-progress"
+    )
+
+    am.evaluate(_status(category="readiness", burn_long=50, burn_short=50))
+    assert not am.firing, "readiness alert must be inhibited mid-repair"
+
+    # the same breach on an availability-category SLO pages right through
+    am.evaluate(_status(category="availability", burn_long=50, burn_short=50))
+    assert "ready-fast" in am.firing
+    del am.firing["ready-fast"]
+
+    # repair over: the readiness breach now fires
+    repair_active[0] = False
+    am.evaluate(_status(category="readiness", burn_long=50, burn_short=50))
+    assert "ready-fast" in am.firing
+    assert am.status()["inhibitors"] == {
+        "readiness": ["slice-repair-in-progress"]
+    }
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: ring, bundles, dedup, capture hooks
+# ---------------------------------------------------------------------------
+
+
+def test_flightrecorder_ring_bounds_and_incident_dedup():
+    t = [0.0]
+    rec = FlightRecorder(
+        capacity=8, max_incidents=2, dedup_window_s=100.0, clock=lambda: t[0]
+    )
+    for i in range(20):
+        rec.record("sample", i=i)
+    assert len(rec) == 8  # bounded ring, oldest dropped
+    assert [r["i"] for r in rec.records("sample")] == list(range(12, 20))
+
+    first = rec.snapshot("slice-degraded", subject="ns/a")
+    same = rec.snapshot("slice-degraded", subject="ns/a")
+    assert first == same, "same (reason, subject) within the window: one bundle"
+    other = rec.snapshot("slice-degraded", subject="ns/b")
+    assert other != first
+    assert {i["subject"] for i in rec.incidents()} == {"ns/a", "ns/b"}
+
+    # capped count: a third distinct incident evicts the oldest
+    rec.snapshot("repair-failed", subject="ns/c")
+    assert len(rec.incidents()) == 2
+    assert rec.get(first) is None
+    bundle = rec.get(other)
+    assert bundle is not None and bundle["reason"] == "slice-degraded"
+    assert bundle["records"], "bundle must carry the ring contents"
+
+    # disabled: zero-cost no-op
+    rec.set_enabled(False)
+    rec.record("sample", i=99)
+    assert rec.snapshot("x") is None
+    assert len(rec) == 8
+
+
+def test_flightrecorder_captures_spans_and_log_records():
+    recorder.clear()
+    tracing.set_enabled(True)
+    tracing.record_span("unit.test.span", notebook="obs/nb-1")
+    spans = [
+        r for r in recorder.records("span") if r["name"] == "unit.test.span"
+    ]
+    assert spans and spans[-1]["attributes"]["notebook"] == "obs/nb-1"
+
+    logger = logging.getLogger("slo-test-logger")
+    handler = recorder.log_handler(level=logging.WARNING)
+    logger.addHandler(handler)
+    try:
+        logger.warning("the dilithium is %s", "depleted")
+    finally:
+        logger.removeHandler(handler)
+    logs = [r for r in recorder.records("log") if "dilithium" in r["message"]]
+    assert logs and logs[-1]["level"] == "WARNING"
+
+
+# ---------------------------------------------------------------------------
+# the black-box canary prober
+# ---------------------------------------------------------------------------
+
+
+def test_canary_probe_full_roundtrip_and_cleanup():
+    from odh_kubeflow_tpu.api.notebook import Notebook
+    from odh_kubeflow_tpu.apimachinery import NotFoundError
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.runtime.prober import CanaryProber, canary_probes_total
+
+    cluster = SimCluster().start()
+    cluster.add_cpu_pool("cpu", nodes=1)
+    mgr = build_manager(
+        cluster.store, Config(slo_enabled=False), http_get=cluster.http_get
+    )
+    mgr.start()
+    prober = CanaryProber(mgr, period_s=60.0, timeout_s=20.0)
+    ok0 = canary_probes_total.value(result="ok")
+    try:
+        result, latency = prober.probe_once()
+
+        # the canary CR goes away (finalizer cleanup is async): a leaked
+        # canary would distort the very availability it measures
+        def canary_gone():
+            try:
+                cluster.client.get(Notebook, prober.namespace, "canary-1")
+                return False
+            except NotFoundError:
+                return True
+
+        _wait_for(canary_gone, msg="canary CR cleaned up")
+    finally:
+        mgr.stop()
+        cluster.stop()
+    assert result == "ok" and latency > 0
+    assert canary_probes_total.value(result="ok") == ok0 + 1
+
+
+# ---------------------------------------------------------------------------
+# definition lint (the ci/slo_lint.sh contract)
+# ---------------------------------------------------------------------------
+
+
+def test_slo_lint_default_definitions_clean():
+    import odh_kubeflow_tpu.runtime.prober  # noqa: F401  (canary families)
+    from odh_kubeflow_tpu.analysis.metric_rules import check_slo_definitions
+    from odh_kubeflow_tpu.controllers.metrics import NotebookMetrics
+    from odh_kubeflow_tpu.runtime.metrics import global_registry
+
+    NotebookMetrics(global_registry)
+    slos = default_slos()
+    assert check_slo_definitions(slos, default_rules(slos), global_registry) == []
+
+
+def test_slo_lint_flags_bad_definitions():
+    from odh_kubeflow_tpu.analysis.metric_rules import check_slo_definitions
+
+    reg = Registry()
+    reg.histogram("real_seconds", "h", buckets=(1, 5))
+    bad_slos = [
+        SLO("ghost", 0.9, LatencyIndicator("no_such_metric_seconds", 1.0)),
+        SLO("offgrid", 0.9, LatencyIndicator("real_seconds", 2.5)),  # not a bucket
+        SLO("outside", 1.5, GaugeIndicator("nope_ratio")),
+    ]
+    bad_rules = [
+        AlertRule("dangling", "no-such-slo", "1h", "5m", 14.4),
+        AlertRule("badwin", "ghost", "2h", "5m", 14.4),
+        # objective 0.9 caps burn at 10x: a 14.4x threshold can never fire
+        AlertRule("deadrule", "ghost", "1h", "5m", 14.4),
+    ]
+    violations = check_slo_definitions(bad_slos, bad_rules, reg)
+    text = "\n".join(violations)
+    assert "unregistered metric 'no_such_metric_seconds'" in text
+    assert "not a bucket boundary" in text
+    assert "objective 1.5 outside" in text
+    assert "undefined SLO 'no-such-slo'" in text
+    assert "unknown window '2h'" in text
+    assert "deadrule" in text and "can never fire" in text
+
+
+def test_default_rules_are_always_feasible():
+    """Burn is capped at 1/error_budget: the shipped rules must clamp their
+    thresholds under the cap, or low-objective SLOs (p50 at 0.50) ship
+    permanently-dead pages."""
+    slos = {s.name: s for s in default_slos()}
+    for rule in default_rules():
+        cap = 1.0 / slos[rule.slo].error_budget
+        assert rule.burn_threshold <= cap, (
+            f"{rule.name}: threshold {rule.burn_threshold} above max burn {cap}"
+        )
+    # the high-objective SLOs keep the canonical Google-SRE thresholds
+    by_name = {r.name: r for r in default_rules()}
+    assert by_name["notebook-availability-fast-burn"].burn_threshold == 14.4
+    assert by_name["readiness-latency-p50-fast-burn"].burn_threshold < 2.0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: seeded slice bad day through the full judgement layer
+# ---------------------------------------------------------------------------
+
+NS = "repair"
+
+
+def _wait_for(fn, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def test_bad_day_fires_alert_mirrors_condition_and_bundles_incidents():
+    """THE acceptance path: seeded slice bad day -> availability burn-rate
+    alert fires within the fast window -> Event + DegradedSLO condition on
+    the affected Notebook -> resolves after repair -> exactly the expected
+    incident bundles on /debug/incidents."""
+    from odh_kubeflow_tpu.api.core import Container, Event, Pod
+    from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+    from odh_kubeflow_tpu.cluster import SimCluster, seeded_slice_bad_day
+    from odh_kubeflow_tpu.controllers import (
+        Config,
+        NotebookReconciler,
+        ProbeStatusController,
+        SliceRepairController,
+        constants as C,
+    )
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.runtime import Manager
+
+    fast = Config(
+        readiness_probe_period_s=0.15,
+        checkpoint_window_s=1.0,
+        repair_max_attempts=4,
+        repair_backoff_s=0.3,
+        repair_backoff_max_s=1.0,
+    )
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("v5p", "v5p", "2x2x2", slices=2)
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=3)
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, fast).setup()
+    ProbeStatusController(mgr, fast, http_get=cluster.http_get).setup()
+    repair = SliceRepairController(mgr, fast, http_get=cluster.http_get)
+    repair.unreachable_dwell_s = 0.6
+    repair.setup()
+    agents: dict = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9, kernels_busy=True))
+
+    # the judgement layer, on scaled windows: 5m -> 0.6s, 1h -> 7.2s
+    slo = SLO(
+        "notebook-availability",
+        objective=0.999,
+        indicator=GaugeIndicator("notebook_available_ratio"),
+        category="availability",
+    )
+    engine = SLOEngine(
+        registry=mgr.metrics, slos=[slo], window_scale=0.002, eval_period_s=0.05
+    )
+    rule = AlertRule(
+        "availability-fast-burn", "notebook-availability", "1h", "5m", 14.4
+    )
+    alert_mgr = AlertManager(rules=[rule], manager=mgr, recorder=recorder)
+    engine.add_listener(alert_mgr.evaluate)
+    mgr.slo_engine = engine
+    mgr.alert_manager = alert_mgr
+    mgr.flight_recorder = recorder
+    mgr.add_service(engine)
+    mgr.start()
+    endpoints = mgr.serve_endpoints(metrics_port=0, health_port=0, host="127.0.0.1")
+
+    def mk_nb(name, acc, topo):
+        nb = Notebook()
+        nb.metadata.name = name
+        nb.metadata.namespace = NS
+        nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+        nb.spec.tpu = TPUSpec(accelerator=acc, topology=topo)
+        return nb
+
+    def get_nb(name):
+        return cluster.client.get(Notebook, NS, name)
+
+    def mesh_ready(name):
+        nb = get_nb(name)
+        return nb.status.tpu is not None and nb.status.tpu.mesh_ready
+
+    def condition(nb, ctype):
+        return next((c for c in nb.status.conditions if c.type == ctype), None)
+
+    try:
+        names = [("a-pod-0", "v5p", "2x2x2"), ("a-pod-1", "v5p", "2x2x2"),
+                 ("a-nb-0", "v5e", "2x2"), ("a-nb-1", "v5e", "2x2")]
+        for name, acc, topo in names:
+            cluster.client.create(mk_nb(name, acc, topo))
+        for name, _, _ in names:
+            _wait_for(lambda n=name: mesh_ready(n), msg=f"{name} up")
+
+        # calm baseline: availability gauge settled at 1.0, nothing firing,
+        # then wipe the recorder so "exactly the expected bundles" is judged
+        # over the bad day alone
+        _wait_for(
+            lambda: engine.evaluate()["notebook-availability"]["windows"]["1h"][
+                "burn_rate"
+            ] < rule.burn_threshold and not alert_mgr.firing,
+            msg="calm baseline before fault injection",
+        )
+        recorder.clear()
+        alert_mgr.history.clear()
+
+        pod_nodes = {}
+        for p in cluster.client.list(Pod, namespace=NS):
+            if p.spec.node_name and p.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
+                pod_nodes[p.metadata.name] = p.spec.node_name
+        fault_t0 = time.monotonic()
+        plan = seeded_slice_bad_day(
+            cluster, seed=0x51CE, pod_nodes=pod_nodes, agents=agents, grace_s=0.4
+        )
+        assert plan["preempted"], "the seeded schedule must preempt something"
+
+        # (1) the burn-rate alert fires within the fast pair's long window
+        _wait_for(
+            lambda: any(h["event"] == "fired" for h in alert_mgr.history),
+            timeout=20, msg="availability burn-rate alert fired",
+        )
+        fired = next(h for h in alert_mgr.history if h["event"] == "fired")
+        assert fired["rule"] == "availability-fast-burn"
+        assert time.monotonic() - fault_t0 < engine.windows["1h"] + 5.0, \
+            "alert did not fire within the fast window"
+        assert fired["notebooks"], "alert must name affected notebooks"
+
+        # (2) mirrored onto the affected Notebook: Event + DegradedSLO=True
+        mirrored_ns, _, mirrored_name = fired["notebooks"][0].partition("/")
+        _wait_for(
+            lambda: any(
+                e.reason == "SLOBurnRate"
+                and e.involved_object.name == mirrored_name
+                for e in cluster.client.list(Event, namespace=mirrored_ns)
+            ),
+            msg="SLOBurnRate event on the affected notebook",
+        )
+        _wait_for(
+            lambda: (c := condition(get_nb(mirrored_name), C.SLO_DEGRADED_CONDITION))
+            is not None and c.status == "True",
+            msg="DegradedSLO=True while firing",
+        )
+
+        # repairs land: maintenance ends, capacity returns
+        time.sleep(1.5)
+        for node in plan["preempted"]:
+            cluster.restore_node(node)
+
+        def settled(name):
+            nb = get_nb(name)
+            state = nb.metadata.annotations.get(C.TPU_REPAIR_STATE_ANNOTATION, "")
+            if state == "failed":
+                return any(
+                    e.reason == "RepairFailed" and e.involved_object.name == name
+                    for e in cluster.client.list(Event, namespace=NS)
+                )
+            if state:
+                return False
+            c = condition(nb, C.TPU_DEGRADED_CONDITION)
+            return mesh_ready(name) and (c is None or c.status == "False")
+
+        for name, _, _ in names:
+            _wait_for(lambda n=name: settled(n), timeout=60,
+                      msg=f"{name} neither repaired nor RepairFailed")
+
+        # (3) the alert resolves once the outage ages out of the long window
+        _wait_for(
+            lambda: not alert_mgr.firing, timeout=40,
+            msg="alert resolved after repair",
+        )
+        resolved = [h for h in alert_mgr.history if h["event"] == "resolved"]
+        assert resolved and resolved[-1]["resolved_at"] > resolved[-1]["since"]
+        _wait_for(
+            lambda: (c := condition(get_nb(mirrored_name), C.SLO_DEGRADED_CONDITION))
+            is not None and c.status == "False" and c.reason == "Recovered",
+            msg="DegradedSLO cleared with reason Recovered",
+        )
+
+        # (4) exactly the expected incident bundles, via /debug/incidents
+        degraded = {
+            e.involved_object.name
+            for e in cluster.client.list(Event, namespace=NS)
+            if e.reason == "SliceDegraded"
+        }
+        failed = {
+            e.involved_object.name
+            for e in cluster.client.list(Event, namespace=NS)
+            if e.reason == "RepairFailed"
+        }
+        assert degraded, "the bad day must degrade at least one notebook"
+        expected = {("slice-degraded", f"{NS}/{n}") for n in degraded}
+        expected |= {("repair-failed", f"{NS}/{n}") for n in failed}
+        expected |= {
+            (f"alert:{h['rule']}", h["slo"])
+            for h in alert_mgr.history
+            if h["event"] == "fired"
+        }
+        host, port = endpoints.metrics_address
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/incidents", timeout=5
+        ) as resp:
+            listing = json.loads(resp.read())
+        observed = {(i["reason"], i["subject"]) for i in listing["incidents"]}
+        assert observed == expected
+
+        # every bundle is fetchable and self-contained (ring + CR state)
+        some_id = next(
+            i["id"] for i in listing["incidents"]
+            if i["reason"] == "slice-degraded"
+        )
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/incidents?id={some_id}", timeout=5
+        ) as resp:
+            bundle = json.loads(resp.read())
+        assert bundle["records"], "bundle carries the flight-recorder ring"
+        assert bundle["state"], "bundle carries CR/pod state"
+        nb_state = next(iter(bundle["state"].values()))
+        assert "notebook" in nb_state and "pods" in nb_state
+
+        # (5) /debug/slo and the /debug/ index serve the judgement layer
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/slo", timeout=5
+        ) as resp:
+            slo_payload = json.loads(resp.read())
+        assert "notebook-availability" in slo_payload["engine"]["slos"]
+        assert slo_payload["alerts"]["rules"][0]["name"] == "availability-fast-burn"
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/", timeout=5
+        ) as resp:
+            index = resp.read().decode()
+        assert "/debug/slo" in index and "/debug/incidents" in index
+
+        assert mgr.healthz(), "a controller/engine thread died during the bad day"
+    finally:
+        endpoints.stop()
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# /debug/traces filters (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_debug_traces_limit_and_notebook_filters():
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.runtime import Manager
+
+    tracing.set_enabled(True)
+    # the shape controller spans actually emit: bare notebook name with the
+    # namespace as its own attribute
+    for i in range(6):
+        tracing.record_span(
+            "filter.span", notebook=f"nb-{i % 2}", namespace="obs"
+        )
+    cluster = SimCluster().start()
+    mgr = Manager(cluster.store)
+    mgr.start()
+    endpoints = mgr.serve_endpoints(metrics_port=0, health_port=0, host="127.0.0.1")
+    try:
+        host, port = endpoints.metrics_address
+
+        def fetch(qs):
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/debug/traces?{qs}", timeout=5
+            ) as resp:
+                return json.loads(resp.read())["spans"]
+
+        assert len(fetch("limit=3")) == 3
+        # both the documented "ns/name" form and the bare name match the
+        # controller-emitted span shape
+        only_zero = fetch("notebook=obs/nb-0&name=filter.span")
+        assert only_zero and all(
+            s["attributes"]["notebook"] == "nb-0" for s in only_zero
+        )
+        assert fetch("notebook=nb-1&name=filter.span")
+        assert fetch("notebook=obs/nb-9&name=filter.span") == []
+        mixed = fetch("name=filter.span&limit=2")
+        assert len(mixed) == 2
+        # malformed limit is a 400, not a stack trace
+        try:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/debug/traces?limit=bogus", timeout=5
+            )
+            raise AssertionError("limit=bogus must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        endpoints.stop()
+        mgr.stop()
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# calm-path overhead: SLO engine + flight recorder < 10% per reconcile
+# ---------------------------------------------------------------------------
+
+
+def _reconcile_loop_wall(n: int) -> float:
+    from odh_kubeflow_tpu.runtime.controller import Controller
+
+    count = [0]
+    done = threading.Event()
+
+    def reconciler(req):
+        count[0] += 1
+        if count[0] >= n:
+            done.set()
+        return None
+
+    ctrl = Controller("slo-overhead", reconciler)
+    ctrl.start()
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            ctrl.enqueue("obs", f"nb-{i}")
+        assert done.wait(60)
+        return time.perf_counter() - t0
+    finally:
+        ctrl.stop()
+
+
+def test_slo_and_flightrecorder_overhead_under_ten_percent():
+    """Acceptance bound: with the SLO engine ticking and the flight recorder
+    sampling every reconcile, the calm path costs <10% extra per reconcile
+    (with a 0.5 ms noise floor — the same min-of-runs methodology as the
+    PR 2 tracing-overhead test)."""
+    n = 300
+    _reconcile_loop_wall(50)  # warm imports/threads before measuring
+
+    recorder.set_enabled(False)
+    try:
+        t_off = min(_reconcile_loop_wall(n) for _ in range(2))
+    finally:
+        recorder.set_enabled(True)
+
+    engine = SLOEngine(slos=default_slos(), window_scale=0.01, eval_period_s=0.05)
+    engine.start()
+    try:
+        t_on = min(_reconcile_loop_wall(n) for _ in range(2))
+    finally:
+        engine.stop()
+
+    baseline_per = t_off / n
+    added_per = max(0.0, t_on - t_off) / n
+    assert added_per < max(0.10 * baseline_per, 0.0005), (
+        f"SLO engine + flight recorder add {added_per * 1e3:.3f} ms per "
+        f"reconcile ({added_per / baseline_per:.0%} of the "
+        f"{baseline_per * 1e3:.3f} ms baseline)"
+    )

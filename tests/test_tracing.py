@@ -112,6 +112,79 @@ def test_root_lifecycle_and_discard():
     assert names == ["root"]  # the discarded root never exported
 
 
+def test_root_registry_metrics_track_active_and_evicted():
+    """ISSUE 5 satellite: the root registry's population and every drop
+    reason are visible on /metrics — a leak shows up as a climbing gauge,
+    not a silent capacity eviction."""
+    from odh_kubeflow_tpu.runtime.metrics import (
+        tracing_roots_active,
+        tracing_roots_evicted_total,
+    )
+
+    deleted0 = tracing_roots_evicted_total.value(reason="deleted")
+    reopened0 = tracing_roots_evicted_total.value(reason="reopened")
+    a = tracing.begin_root("notebook.ready", key="obs/leak-a")
+    tracing.begin_root("notebook.ready", key="obs/leak-b")
+    assert tracing_roots_active.value() == 2
+
+    # close-on-delete: the reconciler's path for a deleted CR
+    dropped = tracing.discard_root_for("obs/leak-a")
+    assert dropped is a
+    assert tracing_roots_active.value() == 1
+    assert tracing_roots_evicted_total.value(reason="deleted") == deleted0 + 1
+    assert tracing.discard_root_for("obs/leak-a") is None  # idempotent
+    assert tracing_roots_evicted_total.value(reason="deleted") == deleted0 + 1
+    # a dropped root is never exported as a span
+    assert tracing.recent_spans(name="notebook.ready") == []
+
+    # stale re-open under the same key counts as an eviction too
+    tracing.begin_root("notebook.ready", key="obs/leak-b")
+    assert tracing_roots_evicted_total.value(reason="reopened") == reopened0 + 1
+    assert tracing_roots_active.value() == 1
+
+
+def test_notebook_delete_closes_open_root():
+    """A notebook deleted before it ever reaches ready must close its
+    readiness root deterministically (the reconciler calls
+    discard_root_for), not leak it until capacity eviction."""
+    from odh_kubeflow_tpu.api.core import Container
+    from odh_kubeflow_tpu.api.notebook import Notebook
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config
+    from odh_kubeflow_tpu.main import build_manager
+
+    cluster = SimCluster().start()
+    # deliberately NO node pool: the notebook can never schedule, so the
+    # root can only close via the delete path under test
+    mgr = build_manager(cluster.store, Config(slo_enabled=False))
+    mgr.start()
+    try:
+        nb = Notebook()
+        nb.metadata.name = "doomed"
+        nb.metadata.namespace = "obs"
+        nb.spec.template.spec.containers = [Container(name="doomed", image="i")]
+        cluster.client.create(nb)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if tracing._root_id_by_key.get("obs/doomed"):
+                break
+            time.sleep(0.02)
+        assert tracing._root_id_by_key.get("obs/doomed"), "webhook opened no root"
+
+        cluster.client.delete(Notebook, "obs", "doomed")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "obs/doomed" not in tracing._root_id_by_key:
+                break
+            time.sleep(0.02)
+        assert "obs/doomed" not in tracing._root_id_by_key, (
+            "deleting the notebook must close its open readiness root"
+        )
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
 # ---------------------------------------------------------------------------
 # the connected readiness trace (acceptance criterion)
 # ---------------------------------------------------------------------------
