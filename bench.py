@@ -783,6 +783,172 @@ def _bench_slo_and_canary(mgr, min_probes: int = 3, wait_s: float = 30.0):
     }
 
 
+def _bench_suspend_resume(notebooks=6, cycles=2, cold_start_s=0.75):
+    """Scripted suspend/resume churn episode (ISSUE 7) in its OWN cluster:
+    cold creates pay a modeled mesh-formation delay (libtpu init + mesh
+    form — the cost a real TPU pod pays on a cold slice), warm-pool binds
+    skip it (env staged, mesh pre-formed). Reports the headline
+    `resume_vs_cold_create_p50` ratio plus the pool hit ratio over the
+    churn."""
+    from odh_kubeflow_tpu.api.core import Container, Node, Pod
+    from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.cluster.slicepool import (
+        slice_pool_hits_total,
+        slice_pool_misses_total,
+    )
+    from odh_kubeflow_tpu.controllers import (
+        Config,
+        NotebookReconciler,
+        ProbeStatusController,
+        SuspendResumeController,
+        constants as CC,
+    )
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.runtime import Manager
+
+    config = Config(
+        suspend_enabled=True,
+        readiness_probe_period_s=0.1,
+        suspend_checkpoint_window_s=2.0,
+        resume_timeout_s=30.0,
+        resume_max_attempts=4,
+        # capacity exactly fits the churn: there is no real pressure, so the
+        # reclaimer must not misread a busy-process scheduling hiccup as
+        # pressure and eat a warm slice mid-measurement
+        reclaim_pending_grace_s=5.0,
+    )
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("warmable", "v5e", "2x2", slices=notebooks)
+    agents = {}
+    cluster.add_pod_behavior(
+        sim_agent_behavior(
+            agents,
+            duty=0.9,
+            cold_start_s=cold_start_s,
+            node_lookup=lambda name: cluster.client.get(Node, "", name),
+        )
+    )
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, config).setup()
+    ProbeStatusController(mgr, config, http_get=cluster.http_get).setup()
+    SuspendResumeController(mgr, config, http_get=cluster.http_get).setup()
+    mgr.start()
+
+    hits0 = slice_pool_hits_total.value()
+    misses0 = slice_pool_misses_total.value()
+
+    def make_nb(name):
+        nb = Notebook()
+        nb.metadata.name = name
+        nb.metadata.namespace = "churn"
+        nb.spec.template.spec.containers = [
+            Container(name=name, image="jupyter:latest")
+        ]
+        nb.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+        return nb
+
+    def mesh_ready(name):
+        nb = cluster.client.get(Notebook, "churn", name)
+        return nb.status.tpu is not None and nb.status.tpu.mesh_ready
+
+    def wait(fn, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if fn():
+                return
+            time.sleep(0.01)
+        raise SystemExit(f"suspend/resume episode: timeout on {what}")
+
+    names = [f"churn-{i}" for i in range(notebooks)]
+    try:
+        # phase A — COLD creates (the baseline the warm pool must beat)
+        cold_s = {}
+        for name in names:
+            t0 = time.monotonic()
+            cluster.client.create(make_nb(name))
+            wait(lambda n=name: mesh_ready(n), 60, f"{name} cold bring-up")
+            cold_s[name] = time.monotonic() - t0
+
+        # phase B — suspend/resume churn
+        resume_s = []
+        for _ in range(cycles):
+            # (re-)wire checkpoint hooks on the CURRENT agent incarnations:
+            # each resume spawns a fresh agent, and a hook left on the old
+            # one would make every later suspend a hookless window-expiry
+            # wait instead of the acked path this episode measures
+            for name in names:
+                agents[f"{name}-0"].checkpoint_hook = lambda: {"step": 1}
+            for name in names:
+                cluster.client.patch(
+                    Notebook, "churn", name,
+                    {"metadata": {"annotations": {
+                        CC.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+                        CC.TPU_SUSPEND_STATE_ANNOTATION: "checkpointing",
+                    }}},
+                )
+            for name in names:
+                wait(
+                    lambda n=name: cluster.client.get(
+                        Notebook, "churn", n
+                    ).metadata.annotations.get(
+                        CC.TPU_SUSPEND_STATE_ANNOTATION
+                    ) == "suspended",
+                    60, f"{name} suspended",
+                )
+            # let every drain finish: a resume measured mid-scale-down pays
+            # pod-name turnover (old ordinal still terminating), which is a
+            # churn-script artifact, not the warm-bind path users hit
+            for name in names:
+                wait(
+                    lambda n=name: not [
+                        p for p in cluster.client.list(
+                            Pod, namespace="churn",
+                            labels={"notebook-name": n},
+                        )
+                        if not p.metadata.deletion_timestamp
+                    ],
+                    60, f"{name} drained",
+                )
+            for name in names:
+                t0 = time.monotonic()
+                cluster.client.patch(
+                    Notebook, "churn", name,
+                    {"metadata": {"annotations": {CC.STOP_ANNOTATION: None}}},
+                )
+                wait(
+                    lambda n=name: mesh_ready(n)
+                    and not cluster.client.get(
+                        Notebook, "churn", n
+                    ).metadata.annotations.get(
+                        CC.TPU_SUSPEND_STATE_ANNOTATION
+                    ),
+                    60, f"{name} resume",
+                )
+                resume_s.append(time.monotonic() - t0)
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+    hits = slice_pool_hits_total.value() - hits0
+    misses = slice_pool_misses_total.value() - misses0
+    cold_p50 = statistics.median(cold_s.values())
+    resume_p50 = statistics.median(resume_s)
+    return {
+        "resume_vs_cold_create_p50": round(resume_p50 / cold_p50, 4),
+        "cold_create_p50_s": round(cold_p50, 4),
+        "resume_p50_s": round(resume_p50, 4),
+        "resumes": len(resume_s),
+        "slice_pool_hit_ratio": round(hits / (hits + misses), 4)
+        if hits + misses else None,
+        "modeled_cold_mesh_formation_s": cold_start_s,
+        "note": "scripted churn: cull->checkpoint->warm-release then "
+        "unstop->warm-claim->restore; cold creates pay a modeled libtpu/"
+        "mesh-formation delay that warm (env-staged, mesh-formed) slices "
+        "skip — the capacity-multiplexing fast path (NotebookOS direction)",
+    }
+
+
 def bench_control_plane():
     from odh_kubeflow_tpu.api.core import Container
     from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
@@ -874,6 +1040,15 @@ def bench_control_plane():
         mgr.stop()
         cluster.stop()
 
+    # suspend/resume churn (ISSUE 7): its own cluster, so the modeled cold
+    # mesh-formation delay doesn't distort the storm numbers above
+    try:
+        suspend_resume = _bench_suspend_resume()
+    except SystemExit as e:
+        suspend_resume = {"error": str(e)}
+    except Exception as e:
+        suspend_resume = {"error": repr(e)[:300]}
+
     out_slo = {
         "slo_readiness_compliance": slo_section.get("compliance"),
         "canary_probe": slo_section.get("canary"),
@@ -884,6 +1059,7 @@ def bench_control_plane():
         out_slo["slo_error"] = slo_section["error"]
     return {
         "slice_repair": slice_repair,
+        "suspend_resume": suspend_resume,
         **out_slo,
         "cr_to_mesh_ready_p50_s": round(statistics.median(latencies.values()), 4),
         # where the time goes: per-phase p50 from the connected readiness

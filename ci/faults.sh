@@ -51,4 +51,18 @@ echo "=== slice chaos lane: RACECHECK=1 iteration ==="
 RACECHECK=1 python -m pytest tests/test_slice_repair.py -q -m "slice_repair and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck, incl. slice chaos) ==="
+# pool-churn soak lane (ISSUE 7): the suspend/resume/reclaim cycle under the
+# seeded pool bad day (warm-host poisoning + reclaim-race conflict storms +
+# the control-plane schedule) — asserts no notebook is ever silently stuck
+# in Resuming, canary CRs are never reclaim victims, and oversubscription
+# degrades by suspending, never by RepairFailed/ResumeFailed
+for i in $(seq 1 "$REPEAT"); do
+    echo "=== pool churn lane: iteration $i/$REPEAT ==="
+    python -m pytest tests/test_suspend.py -q -m "suspend and not slow" \
+        -p no:cacheprovider -p no:randomly "$@"
+done
+echo "=== pool churn lane: RACECHECK=1 iteration ==="
+RACECHECK=1 python -m pytest tests/test_suspend.py -q -m "suspend and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck, incl. slice chaos + pool churn) ==="

@@ -5,6 +5,12 @@
 # the same one-source-of-truth pattern as the registry lint — then the
 # slo-marked pytest contract tests rerun (burn-rate math, alert lifecycle,
 # inhibition, flight-recorder bundles, the bad-day acceptance soak).
+#
+# Since ISSUE 7 the lint also covers the suspend/resume layer: the
+# `resume-latency` SLO's notebook_resume_seconds histogram and the
+# slice_pool_{size,hit_ratio} gauges (cluster/slicepool.py) register into
+# the same live registry the lint checks, so a renamed pool series or an
+# off-bucket resume threshold fails here, not in a dashboard.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -23,6 +23,7 @@ from .framework import all_checkers, run_analysis
 def _registry_lint() -> int:
     """Import every metric-registration site, then lint the live global
     registry — the Python half metrics_lint.sh delegates to."""
+    import odh_kubeflow_tpu.cluster.slicepool  # noqa: F401
     import odh_kubeflow_tpu.runtime.controller  # noqa: F401
     import odh_kubeflow_tpu.runtime.metrics as m
     import odh_kubeflow_tpu.runtime.workqueue  # noqa: F401
@@ -51,6 +52,7 @@ def _slo_lint() -> int:
     definitions, then lint the definitions against the live registry — the
     ci/slo_lint.sh entry (metric_rules.check_slo_definitions is the one
     source of truth, like the registry lint)."""
+    import odh_kubeflow_tpu.cluster.slicepool  # noqa: F401  (pool + resume)
     import odh_kubeflow_tpu.runtime.controller  # noqa: F401
     import odh_kubeflow_tpu.runtime.flightrecorder  # noqa: F401
     import odh_kubeflow_tpu.runtime.metrics as m

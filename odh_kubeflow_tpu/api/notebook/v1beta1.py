@@ -28,6 +28,10 @@ class TPUSpec(KubeModel):
     chips: int = 0  # alternative to topology: minimum total chip count
     runtime: str = ""  # "jax" (default) | "pytorch-xla"
     reserved: Optional[bool] = None  # reservation-bound node pool
+    # oversubscription reclaim ordering (controllers/suspend.py): under
+    # capacity pressure the LOWEST-priority suspend-eligible slice is
+    # checkpoint-suspended first; higher survives longer
+    priority: int = 0
 
 
 @dataclass

@@ -17,9 +17,11 @@ from .faults import (
     FaultInjector,
     FaultRule,
     seeded_bad_day,
+    seeded_pool_bad_day,
     seeded_slice_bad_day,
 )
 from .kubelet import Behavior, Kubelet, NodeLifecycle, PodDecision
+from .slicepool import PoolEntry, SlicePool
 from .remote import RemoteStore, RemoteWatch
 from .webhook_dispatch import WebhookDispatcher
 from .scheduler import Scheduler

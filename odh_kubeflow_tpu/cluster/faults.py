@@ -202,6 +202,17 @@ class FaultInjector:
             raise RuntimeError("no SimCluster bound (FaultInjector.bind_cluster)")
         cluster.restore_node(node_name)
 
+    def poison_host(self, node_name: str) -> None:
+        """Silently fail a host (Ready=False, no taint, no notice) — the
+        pool-poisoning op: a warm slice whose host dies unannounced sits in
+        the pool as a trap until the suspend controller's sweep or a
+        claim-time health check evicts it. Heal with restore_host."""
+        with self._lock:
+            cluster = self._cluster
+        if cluster is None:
+            raise RuntimeError("no SimCluster bound (FaultInjector.bind_cluster)")
+        cluster.fail_node(node_name)
+
     # -- scripted fault constructors --
 
     def conflict_storm(self, kind: str, times: int = 3) -> FaultRule:
@@ -260,6 +271,18 @@ class FaultInjector:
         after `restarts` firings the pod comes back up."""
         return self.add(FaultRule(
             site="kubelet.pod", name=name, times=restarts, action="crash"))
+
+    def reclaim_race(self, times: int = 3) -> FaultRule:
+        """The next `times` Node updates 409 — exactly the write the warm-
+        pool claim CAS rides (cluster/slicepool.py _stamp). Two resumes
+        racing for the last warm slice plus this storm exercise the
+        lose-and-move-on path: the loser must fall to the next pool or a
+        cold miss, never double-claim or wedge."""
+        return self.add(FaultRule(
+            site="store.write", kind="Node", times=times,
+            match=lambda ctx: ctx.get("verb") == "update",
+            error=lambda: ConflictError("injected reclaim race on Node"),
+        ))
 
     def partition_probe(self, host: Optional[str] = None,
                         times: Optional[int] = None) -> FaultRule:
@@ -338,6 +361,41 @@ def seeded_slice_bad_day(
                 else:
                     monitor.ici_fault = True
                     plan["ici"].append(pod)
+    if cp_seed is not None:
+        seeded_bad_day(cluster.faults, seed=cp_seed)
+    return plan
+
+
+def seeded_pool_bad_day(
+    cluster: Any,
+    seed: int,
+    warm_nodes: List[str],
+    control_plane: bool = True,
+) -> Dict[str, List[str]]:
+    """One deterministic warm-pool bad day (ISSUE 7): every choice drawn from
+    random.Random(seed).
+
+    - **pool poisoning**: a seeded subset of the given WARM hosts fails
+      silently (Ready=False, nothing announced) — resumes must route around
+      the trap via the pool sweep / claim-time health check, never wedge on
+      a dead warm slice,
+    - **reclaim race**: a Node-update conflict storm lands exactly on the
+      claim CAS writes, so racing claimants exercise the lose-and-move-on
+      path,
+    - plus the usual control-plane schedule (seeded_bad_day).
+
+    Returns {"poisoned": [nodes]} so the soak can heal and assert outcomes.
+    """
+    rng = random.Random(seed)
+    cp_seed = rng.randrange(2**31) if control_plane else None
+    plan: Dict[str, List[str]] = {"poisoned": []}
+    candidates = sorted(warm_nodes)
+    if candidates:
+        n = rng.randint(1, max(1, len(candidates) // 2))
+        for node in rng.sample(candidates, min(n, len(candidates))):
+            cluster.fail_node(node)
+            plan["poisoned"].append(node)
+    cluster.faults.reclaim_race(times=rng.randint(2, 6))
     if cp_seed is not None:
         seeded_bad_day(cluster.faults, seed=cp_seed)
     return plan

@@ -14,7 +14,12 @@ every multi-host pool IS one ICI slice. Placement rules:
 - unschedulable pods requeue with exponential backoff AND are re-attempted
   the moment capacity frees (node added/restored, a scheduled pod deleted) —
   a waiting gang must not sit out a full backoff window after the slice it
-  needs opens up.
+  needs opens up,
+- **warm slice pools** (cluster/slicepool.py): a pool whose nodes carry
+  `pool-state=warm` is held for resume binds — no pods land there until the
+  suspend controller claims it or the reclaimer returns it to general
+  capacity; `pool-state=claimed` pools accept ONLY the claiming notebook's
+  pods (the resume fast path).
 """
 from __future__ import annotations
 
@@ -189,6 +194,15 @@ class Scheduler:
                 if sibling_pool is not None and sibling_pool != pool_name:
                     continue
                 pool_nodes = pools[pool_name]
+                # warm-pool reservation: warm slices take nobody; claimed
+                # slices take only the claiming notebook's pods. An owner-less
+                # pod (no notebook-name label) must never slip through the
+                # warm sentinel ("" == "") onto a reserved slice.
+                reservation = self._pool_reservation(pool_nodes)
+                if reservation is not None:
+                    owner = self._pod_owner(pod)
+                    if not owner or reservation != owner:
+                        continue
                 free = [
                     n for n in pool_nodes if self._node_free(n, pod, tpu_chips, assignment)
                 ]
@@ -223,6 +237,35 @@ class Scheduler:
         self._unsched_attempts.pop(req.key, None)
         pod.spec.node_name = chosen.metadata.name
         self.client.update(pod)
+        return None
+
+    @staticmethod
+    def _pod_owner(pod: Pod) -> str:
+        """ns/notebook of a notebook pod — what a claimed pool's
+        `pool-claimed-by` must equal for the bind to be allowed."""
+        from ..controllers.constants import NOTEBOOK_NAME_LABEL
+
+        nb = pod.metadata.labels.get(NOTEBOOK_NAME_LABEL, "")
+        return f"{pod.metadata.namespace}/{nb}" if nb else ""
+
+    @staticmethod
+    def _pool_reservation(pool_nodes: List[Node]) -> Optional[str]:
+        """None = unreserved; "" = warm (held for resume binds, takes
+        nobody); "ns/name" = claimed by that notebook. Judged off the lead
+        node — the claim CAS serializes on it (cluster/slicepool.py)."""
+        from .slicepool import (
+            POOL_CLAIMED_BY_ANNOTATION,
+            POOL_STATE_ANNOTATION,
+            POOL_STATE_CLAIMED,
+            POOL_STATE_WARM,
+        )
+
+        lead = min(pool_nodes, key=lambda n: n.metadata.name)
+        state = lead.metadata.annotations.get(POOL_STATE_ANNOTATION, "")
+        if state == POOL_STATE_WARM:
+            return ""
+        if state == POOL_STATE_CLAIMED:
+            return lead.metadata.annotations.get(POOL_CLAIMED_BY_ANNOTATION, "")
         return None
 
     def _sibling_pool(self, pod: Pod) -> Optional[str]:

@@ -180,6 +180,29 @@ class SimCluster:
 
         self._retry_persistent(attempt)
 
+    def fail_node(self, name: str) -> None:
+        """Silent host failure: the node goes Ready=False with NO taint and
+        NO maintenance notice — nothing announced it. This is the
+        pool-poisoning shape (ISSUE 7 bad-day op): a WARM slice whose host
+        dies silently sits in the pool as a trap until the suspend
+        controller's sweep (or a claim-time health check) evicts it.
+        Heal with restore_node."""
+
+        def attempt():
+            node = self.client.get(Node, "", name)
+            node.status.conditions = [
+                Condition(
+                    type="Ready",
+                    status="False",
+                    reason="NodeFailure",
+                    message="host failed silently (injected)",
+                    last_transition_time=now_rfc3339(),
+                )
+            ]
+            self.client.update_status(node)
+
+        self._retry_persistent(attempt)
+
     def restore_node(self, name: str) -> None:
         """Maintenance over: taint + notice removed, node Ready again —
         capacity returns and the scheduler's capacity-freed watch re-attempts

@@ -447,6 +447,17 @@ class Store:
             meta.pop("deletionTimestamp", None)
             raw = bucket.store(key, obj)  # one serialization; never aliases obj
             self._emit(av, kind, WatchEvent(ADDED, json.loads(raw)))
+            if self._gc_enabled and self._owner_dangling(obj):
+                # k8s GC-controller semantics, made synchronous like the
+                # cascade above: an object created with a DANGLING owner
+                # reference (owner deleted between the creator's read and
+                # this create — e.g. a mid-flight reconcile re-creating a
+                # StatefulSet after its Notebook's cascade delete) is
+                # collected immediately instead of surviving as an orphan
+                # no future delete will ever cascade to. The create still
+                # returns success (as in k8s, where GC runs async); watchers
+                # see ADDED then DELETED and converge level-triggered.
+                self._remove(av, kind, bucket, key)
             return json.loads(raw)
 
     def get_raw(self, api_version: str, kind: str, namespace: str, name: str) -> Dict[str, Any]:
@@ -624,6 +635,31 @@ class Store:
         self._emit(api_version, kind, WatchEvent(DELETED, obj))
         if self._gc_enabled:
             self._cascade_delete(obj)
+
+    def _owner_dangling(self, obj: Dict[str, Any]) -> bool:
+        """True when any uid-carrying ownerReference points at an owner that
+        no longer exists (or exists with a different uid — same name,
+        recreated object). Callers hold self._lock."""
+        meta = obj.get("metadata", {})
+        ns = meta.get("namespace", "")
+        for ref in meta.get("ownerReferences", []):
+            uid = ref.get("uid")
+            if not uid:
+                continue
+            # resolve through the STORAGE key: a spoke-version ownerReference
+            # (e.g. kubeflow.org/v1 Notebook) lives in the hub's bucket, and
+            # the raw (apiVersion, kind) key would read every spoke-owned
+            # object as dangling and GC it at birth
+            bucket = self._objects.get(
+                self._storage_key(ref.get("apiVersion", ""), ref.get("kind", ""))
+            )
+            owner = None
+            if bucket is not None:
+                owner = bucket.get(self._obj_key(ns, ref.get("name", ""))) \
+                    or bucket.get(self._obj_key("", ref.get("name", "")))
+            if owner is None or owner["metadata"].get("uid") != uid:
+                return True
+        return False
 
     def _cascade_delete(self, owner: Dict[str, Any]) -> None:
         """Owner-reference garbage collection (synchronous cascade for

@@ -5,5 +5,6 @@ from .notebook import EventMirrorController, NotebookReconciler, hosts_service_n
 from .culling import CullingReconciler
 from .probe_status import ProbeStatusController
 from .slice_repair import SliceRepairController
+from .suspend import SuspendResumeController
 from .webhook import NotebookWebhook
 from .extension import TPUWorkbenchReconciler

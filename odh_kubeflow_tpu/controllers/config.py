@@ -49,6 +49,34 @@ class Config:
     repair_max_attempts: int = 6
     repair_backoff_s: float = 1.0
     repair_backoff_max_s: float = 30.0
+    # suspend/resume + warm slice pools (controllers/suspend.py,
+    # cluster/slicepool.py): culling a TPU notebook checkpoints kernel state
+    # and releases the slice mesh-formed into a warm pool instead of tearing
+    # it down; resume binds from the pool (hit) or falls back to cold
+    # placement (miss). Opt-in like culling itself.
+    suspend_enabled: bool = False
+    # checkpoint-before-suspend window (the cull path's analog of the repair
+    # path's checkpoint_window_s)
+    suspend_checkpoint_window_s: float = 15.0
+    # per-ordinal checkpoint-hook retries inside the window: bounded, jittered
+    # (the cluster/client.py 429 pattern) so one transient probe-agent blip
+    # never aborts the whole suspend
+    suspend_checkpoint_retries: int = 3
+    suspend_checkpoint_backoff_s: float = 0.2
+    # resume: one attempt = one warm-claim-or-cold-placement try; a resume
+    # that hasn't reached mesh-ready within resume_timeout_s re-claims (a
+    # poisoned warm slice must not wedge the notebook), ResumeFailed after max
+    resume_timeout_s: float = 60.0
+    resume_max_attempts: int = 6
+    # oversubscription policy: total admitted chip demand (active + suspended
+    # notebooks) may exceed physical chips up to this budget; a cold create /
+    # resume that finds no capacity reclaims the lowest-priority pool-idle or
+    # suspend-eligible slice. 0 = no budget cap (reclaim still gated on a
+    # suitable victim existing). Demand beyond the budget queues, untouched.
+    chip_budget: int = 0
+    # how long a TPU pod must sit unschedulable before the reclaimer acts —
+    # the scheduler's capacity-freed fast path gets first shot
+    reclaim_pending_grace_s: float = 1.0
     # SLO engine + alerting (runtime/slo.py, runtime/alerts.py): window_scale
     # shrinks the canonical 5m/30m/1h/6h burn windows (soaks/tests run the
     # real rule shapes in seconds); eval period 0 derives from the scale
@@ -119,6 +147,23 @@ class Config:
             c.repair_backoff_s = float(os.environ["REPAIR_BACKOFF_S"])
         if os.environ.get("REPAIR_BACKOFF_MAX_S"):
             c.repair_backoff_max_s = float(os.environ["REPAIR_BACKOFF_MAX_S"])
+        c.suspend_enabled = _env_bool("ENABLE_SUSPEND", c.suspend_enabled)
+        if os.environ.get("SUSPEND_CHECKPOINT_WINDOW_S"):
+            c.suspend_checkpoint_window_s = float(
+                os.environ["SUSPEND_CHECKPOINT_WINDOW_S"]
+            )
+        if os.environ.get("RESUME_TIMEOUT_S"):
+            # clamp: a zero/negative timeout would burn every resume attempt
+            # in one reconcile pass and land straight in ResumeFailed
+            c.resume_timeout_s = max(0.1, float(os.environ["RESUME_TIMEOUT_S"]))
+        if os.environ.get("RESUME_MAX_ATTEMPTS"):
+            c.resume_max_attempts = max(1, int(os.environ["RESUME_MAX_ATTEMPTS"]))
+        if os.environ.get("CHIP_BUDGET"):
+            c.chip_budget = max(0, int(os.environ["CHIP_BUDGET"]))
+        if os.environ.get("RECLAIM_PENDING_GRACE_S"):
+            c.reclaim_pending_grace_s = max(
+                0.0, float(os.environ["RECLAIM_PENDING_GRACE_S"])
+            )
         c.slo_enabled = _env_bool("SLO_ENABLED", c.slo_enabled)
         if os.environ.get("SLO_WINDOW_SCALE"):
             # clamp: non-positive would collapse every burn window to zero

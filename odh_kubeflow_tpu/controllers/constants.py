@@ -69,6 +69,33 @@ TPU_DEGRADED_CONDITION = "Degraded"
 # while a burn-rate alert fires; cleared (reason Recovered) at resolution
 SLO_DEGRADED_CONDITION = "DegradedSLO"
 
+# -- suspend / resume (controllers/suspend.py) --
+# The capacity-multiplexing state machine, annotation-durable like the repair
+# machine above:
+#   Active -> Checkpointing (cull/stop with state saved before the scale-down)
+#          -> Suspended (slice released to the warm pool; replicas 0)
+#          -> Resuming (unstop: warm-pool claim or cold fallback)
+#          -> Active (mesh ready again)  |  ResumeFailed (attempts exhausted)
+TPU_SUSPEND_STATE_ANNOTATION = "notebooks.tpu.kubeflow.org/suspend-state"
+TPU_SUSPEND_STARTED_ANNOTATION = "notebooks.tpu.kubeflow.org/suspend-started"
+TPU_SUSPENDED_AT_ANNOTATION = "notebooks.tpu.kubeflow.org/suspended-at"
+TPU_RESUME_STARTED_ANNOTATION = "notebooks.tpu.kubeflow.org/resume-started"
+TPU_RESUME_ATTEMPTS_ANNOTATION = "notebooks.tpu.kubeflow.org/resume-attempts"
+# checkpoint deadline of the suspend path (the repair path has its own key
+# above; two concurrent windows must not clobber each other's deadline)
+TPU_SUSPEND_CHECKPOINT_DEADLINE_ANNOTATION = (
+    "notebooks.tpu.kubeflow.org/suspend-checkpoint-deadline"
+)
+# stamped (with the reclaim reason) when a suspend was FORCED by the
+# oversubscription reclaimer rather than idleness: the suspend path then
+# returns the slice to general capacity instead of the warm pool — the
+# requester that triggered the reclaim needs the chips
+TPU_RECLAIM_ANNOTATION = "notebooks.tpu.kubeflow.org/reclaimed"
+# never a reclaim victim: the SLO canary (runtime/prober.py) stamps this on
+# its CRs — suspending the prober would blind the very signal that detects
+# the pressure incident
+TPU_RECLAIM_EXEMPT_LABEL = "notebooks.tpu.kubeflow.org/reclaim-exempt"
+
 # -- TPU-native additions --
 TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"
 # stamped on Events the mirror controller creates, and checked on ingest, so

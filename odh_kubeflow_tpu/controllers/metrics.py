@@ -114,6 +114,18 @@ class NotebookMetrics:
                     or not nb.spec.tpu.accelerator
                     or nb.metadata.deletion_timestamp
                     or C.STOP_ANNOTATION in nb.metadata.annotations
+                    # mid-suspend/resume (controllers/suspend.py) is a
+                    # PLANNED transition, not downtime: a fleet-wide morning
+                    # rush of resumes must not burn the availability budget
+                    # (resume slowness is the resume-latency SLO's
+                    # jurisdiction, exactly as bring-up belongs to
+                    # readiness-latency). Terminal resume-failed is NOT
+                    # planned — a user locked out of a dead resume is
+                    # exactly what availability must page on, so it stays
+                    # counted (and, never mesh-ready, counts unavailable).
+                    or nb.metadata.annotations.get(
+                        C.TPU_SUSPEND_STATE_ANNOTATION
+                    ) in ("checkpointing", "suspended", "resuming")
                     or nb.status.tpu is None
                     or not nb.status.tpu.first_ready_time
                 ):

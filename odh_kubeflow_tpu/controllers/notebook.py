@@ -137,6 +137,17 @@ class NotebookReconciler:
         sts.metadata.labels = {C.NOTEBOOK_NAME_LABEL: nb.metadata.name}
 
         stopped = C.STOP_ANNOTATION in nb.metadata.annotations
+        if (
+            stopped
+            and nb.metadata.annotations.get(C.TPU_SUSPEND_STATE_ANNOTATION)
+            == "checkpointing"
+        ):
+            # checkpoint-before-suspend window (controllers/suspend.py): the
+            # stop is real but the scale-down waits — every ready host's
+            # /tpu/checkpoint hook must be driven while the pods still exist.
+            # The suspend controller flips the state to "suspended" (bounded
+            # window), and THEN replicas go to 0.
+            stopped = False
         hosts = shape.hosts if shape else 1
         sts.spec.replicas = 0 if stopped else hosts
         sts.spec.selector.match_labels = {C.NOTEBOOK_NAME_LABEL: nb.metadata.name}

@@ -182,6 +182,23 @@ class SliceRepairController:
             self._forget(req.key)
             return None
 
+        if ann.get(C.TPU_SUSPEND_STATE_ANNOTATION):
+            # suspend machine owns the slice (resuming: stop already cleared
+            # but the warm-pool bind is in flight). A half-started resume
+            # looks exactly like HostUnreachable — "repairing" (evicting) it
+            # would race the suspend controller for the same warm slice.
+            # Contract (ARCHITECTURE.md): repair waits; the suspend machine's
+            # own bounded attempts + the reclaimer handle a wedged resume.
+            if state:
+                self._patch_annotations(nb, self._clear_updates())
+                write_condition(
+                    self.client, self.api_reader, nb,
+                    C.TPU_DEGRADED_CONDITION, "False", "Suspended",
+                    "repair aborted: suspend/resume machine owns the slice",
+                )
+            self._forget(req.key)
+            return None
+
         now = time.time()
         # goodput integrator: every reconcile extends tracked lifetime; time
         # spent in any repair state is downtime

@@ -19,6 +19,7 @@ from .controllers import (
     NotebookWebhook,
     ProbeStatusController,
     SliceRepairController,
+    SuspendResumeController,
     TPUWorkbenchReconciler,
 )
 from .controllers.metrics import NotebookMetrics
@@ -57,6 +58,7 @@ def build_manager(
     ProbeStatusController(mgr, config, http_get=http_get, metrics=metrics).setup()
     CullingReconciler(mgr, config, http_get=http_get, metrics=metrics).setup()
     SliceRepairController(mgr, config, http_get=http_get).setup()
+    SuspendResumeController(mgr, config, http_get=http_get).setup()
     if config.slo_enabled:
         _wire_observability(mgr, config)
     return mgr

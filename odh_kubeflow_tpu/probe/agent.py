@@ -536,7 +536,9 @@ class NotebookAgent:
 
 def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
                        kernels_busy: bool = True, chips: Optional[int] = None,
-                       visible_chips: Optional[Any] = None):
+                       visible_chips: Optional[Any] = None,
+                       cold_start_s: float = 0.0,
+                       node_lookup: Optional[Any] = None):
     """Kubelet-sim pod behavior running one NotebookAgent per notebook pod.
 
     The shared fixture for tests, bench.py and the loadtest: caches one agent
@@ -549,9 +551,42 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
     visible_chips degrades REPORTED visibility from agent birth (expected
     stays at the pod's request) — int for all pods, or {pod_name: chips} for
     per-host degradation; scripting it post-hoc via agents[...] races the
-    probe controller's first poll."""
+    probe controller's first poll.
+
+    cold_start_s models the COLD slice bring-up cost a real TPU pod pays
+    (libtpu init + mesh formation) as kubelet-visible startup latency; a pod
+    landing on a warm-pool node (pool-state annotation present: libtpu env
+    staged, mesh pre-formed — cluster/slicepool.py) skips it. `node_lookup`
+    (name -> Node) resolves the pod's node for that check; required only
+    when cold_start_s > 0."""
     from ..controllers import constants as C
     from ..tpu import TPU_RESOURCE
+
+    delay_memo: Dict[str, float] = {}
+
+    def startup_delay(pod) -> float:
+        if cold_start_s <= 0:
+            return 0.0
+        # sticky per pod incarnation: the claim clears at resume COMPLETION,
+        # and re-judging then would retroactively owe the cold delay and
+        # flip a Ready pod back to Pending
+        memo_key = pod.metadata.uid
+        if memo_key in delay_memo:
+            return delay_memo[memo_key]
+        if node_lookup is not None and pod.spec.node_name:
+            from ..cluster.slicepool import POOL_STATE_ANNOTATION
+
+            try:
+                node = node_lookup(pod.spec.node_name)
+            except Exception:
+                node = None
+            if node is not None and node.metadata.annotations.get(
+                POOL_STATE_ANNOTATION
+            ):
+                delay_memo[memo_key] = 0.0  # warm: env staged, mesh formed
+                return 0.0
+        delay_memo[memo_key] = cold_start_s
+        return cold_start_s
 
     def behavior(pod):
         if not pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
@@ -597,6 +632,8 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
 
         from ..cluster.kubelet import PodDecision
 
-        return PodDecision(serve=lambda p: agent.serve())
+        return PodDecision(
+            ready_after=startup_delay(pod), serve=lambda p: agent.serve()
+        )
 
     return behavior

@@ -103,10 +103,15 @@ class CanaryProber:
     def _make_canary(self, name: str):
         from ..api.core import Container
         from ..api.notebook import Notebook, TPUSpec
+        from ..controllers import constants as C
 
         nb = Notebook()
         nb.metadata.name = name
         nb.metadata.namespace = self.namespace
+        # never a reclaim victim (controllers/suspend.py): suspending the
+        # canary under capacity pressure would blind the very probe that
+        # detects the pressure incident
+        nb.metadata.labels[C.TPU_RECLAIM_EXEMPT_LABEL] = "true"
         nb.spec.template.spec.containers = [
             Container(name=name, image="jupyter:canary")
         ]

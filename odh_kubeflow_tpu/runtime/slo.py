@@ -181,6 +181,15 @@ def default_slos() -> Tuple[SLO, ...]:
             category="readiness",
         ),
         SLO(
+            "resume-latency",
+            objective=0.90,
+            indicator=LatencyIndicator("notebook_resume_seconds", 30.0),
+            description="90% of suspend->resume round trips return to "
+            "mesh-ready within 30s (warm-pool binds make this; a fleet of "
+            "cold-fallback misses burns it)",
+            category="readiness",
+        ),
+        SLO(
             "notebook-availability",
             objective=0.999,
             indicator=GaugeIndicator("notebook_available_ratio"),

@@ -307,6 +307,119 @@ def test_culling_suspended_while_degraded_and_clock_resets_after_repair():
         cluster.stop()
 
 
+def test_resume_rearms_idle_clock_no_instant_recull():
+    """ISSUE 7 satellite: a resumed notebook's idleness clock starts at
+    RESUME time, not the preserved pre-suspend last-activity — else a
+    just-resumed notebook is instantly re-culled — and the clock is
+    suspended entirely while the resume is in flight. The notebook must be
+    (a) resumable without an instant re-cull, and (b) still cullable (back
+    into suspension) once genuinely idle afterwards."""
+    from odh_kubeflow_tpu.api.notebook import TPUSpec
+    from odh_kubeflow_tpu.controllers import (
+        ProbeStatusController,
+        SuspendResumeController,
+    )
+
+    config = Config(
+        enable_culling=True,
+        suspend_enabled=True,
+        # a WIDE idle threshold: the "no instant re-cull" window below must
+        # stay clear of the legitimate next cull even when a loaded suite
+        # delays the resume-detection poll by a second or two
+        cull_idle_time_min=4.0 / 60.0,  # 4.0 s idle threshold
+        idleness_check_period_min=0.1 / 60.0,
+        readiness_probe_period_s=0.1,
+        suspend_checkpoint_window_s=0.5,
+        resume_timeout_s=20.0,
+        resume_max_attempts=4,
+    )
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("pool", "v5e", "2x2")
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, config).setup()
+    CullingReconciler(mgr, config, http_get=cluster.http_get).setup()
+    ProbeStatusController(mgr, config, http_get=cluster.http_get).setup()
+    SuspendResumeController(mgr, config, http_get=cluster.http_get).setup()
+    agents = {}
+    # idle from the start: the culler suspends the notebook on its own
+    cluster.add_pod_behavior(
+        sim_agent_behavior(agents, duty=0.0, kernels_busy=False, chips=4)
+    )
+    mgr.start()
+    try:
+        cluster.client.create(
+            mk_nb("napper", tpu=TPUSpec(accelerator="v5e", topology="2x2"))
+        )
+        # culled INTO suspension (the culler's stop patch carries the
+        # checkpointing stamp when suspend is enabled)
+        wait_for(
+            lambda: get_nb(cluster, "napper").metadata.annotations.get(
+                C.TPU_SUSPEND_STATE_ANNOTATION
+            ) == "suspended",
+            timeout=20,
+            msg="culled into Suspended",
+        )
+        # the poisoned clock: a preserved pre-suspend last-activity, hours
+        # old (a culler that never got to remove it before the unstop)
+        cluster.client.patch(
+            Notebook, "user", "napper",
+            {"metadata": {"annotations": {
+                C.LAST_ACTIVITY_ANNOTATION: "2020-01-01T00:00:00Z",
+            }}},
+        )
+        t_unstop = time.time()
+        cluster.client.patch(
+            Notebook, "user", "napper",
+            {"metadata": {"annotations": {C.STOP_ANNOTATION: None}}},
+        )
+        # (a) resume completes — the mid-resume clock suspension means the
+        # 2020 annotation never triggers a cull DURING the resume, and the
+        # re-arm means none fires right after it either
+        wait_for(
+            lambda: not get_nb(cluster, "napper").metadata.annotations.get(
+                C.TPU_SUSPEND_STATE_ANNOTATION
+            )
+            and get_nb(cluster, "napper").status.tpu is not None
+            and get_nb(cluster, "napper").status.tpu.mesh_ready,
+            timeout=30,
+            msg="resumed",
+        )
+        assert C.STOP_ANNOTATION not in get_nb(
+            cluster, "napper"
+        ).metadata.annotations
+        from odh_kubeflow_tpu.apimachinery import parse_time
+
+        # wait_for, not a one-shot read: a culler removal patch from the
+        # suspended phase can race just past the resume's re-arm; the next
+        # culler pass re-initializes the annotation to now either way
+        def rearmed():
+            ts = get_nb(cluster, "napper").metadata.annotations.get(
+                C.LAST_ACTIVITY_ANNOTATION
+            )
+            return bool(ts) and parse_time(ts).timestamp() >= t_unstop - 1.0
+
+        wait_for(rearmed, timeout=10,
+                 msg="idle clock re-armed from resume time")
+        # no instant re-cull off stale state: survive well under a threshold
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            assert (
+                C.STOP_ANNOTATION
+                not in get_nb(cluster, "napper").metadata.annotations
+            ), "re-culled instantly after resume"
+            time.sleep(0.1)
+        # (b) a genuinely idle notebook is still culled (re-suspended) later
+        wait_for(
+            lambda: C.STOP_ANNOTATION
+            in get_nb(cluster, "napper").metadata.annotations,
+            timeout=30,
+            msg="culled again once genuinely idle",
+        )
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
 def test_dev_mode_probes_through_local_proxy():
     """DEV mode (reference culling_controller.go:249-273): probes route
     through a localhost:8001 kubectl-proxy URL instead of the in-cluster

@@ -80,7 +80,12 @@ def test_cpu_notebook_end_to_end(env):
     assert tmpl_c.ports[0].container_port == C.NOTEBOOK_PORT
     assert sts.spec.template.spec.security_context.fs_group == C.DEFAULT_FS_GROUP
 
-    svc = cluster.client.get(Service, "user", "mini")
+    # wait_for, not a one-shot get: the reconcile creates the STS a few ms
+    # before the Service in the same pass, and the STS wait above returns
+    # inside exactly that gap on a loaded box
+    svc = wait_for(
+        lambda: cluster.client.get(Service, "user", "mini"), msg="service"
+    )
     assert svc.spec.ports[0].port == 80
     assert svc.spec.ports[0].target_port == C.NOTEBOOK_PORT
     assert svc.spec.ports[0].name == C.NOTEBOOK_PORT_NAME

@@ -508,10 +508,20 @@ def test_bad_day_fires_alert_mirrors_condition_and_bundles_incidents():
             ),
             msg="SLOBurnRate event on the affected notebook",
         )
+        # the condition mirror must have LANDED — either still True (alert
+        # firing) or already flipped False/Recovered: on a loaded box (the
+        # suite now runs two more controllers' watch fan-out) the repair can
+        # complete and the fast pair resolve before this wait even starts,
+        # and Recovered is itself proof the True mirror happened (only the
+        # resolution path writes that reason). Step (3) below still asserts
+        # the full True -> False/Recovered lifecycle ends Recovered.
         _wait_for(
             lambda: (c := condition(get_nb(mirrored_name), C.SLO_DEGRADED_CONDITION))
-            is not None and c.status == "True",
-            msg="DegradedSLO=True while firing",
+            is not None and (
+                c.status == "True"
+                or (c.status == "False" and c.reason == "Recovered")
+            ),
+            msg="DegradedSLO mirrored while firing (or already recovered)",
         )
 
         # repairs land: maintenance ends, capacity returns

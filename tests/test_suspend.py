@@ -1,0 +1,736 @@
+"""Suspend/resume + warm slice pools (ISSUE 7): cull→checkpoint→pool-release,
+warm-hit resume, pool-miss cold fallback, priority-based reclaim under
+oversubscription, and the seeded churn soak asserting no notebook is ever
+silently stuck in Resuming.
+
+Deterministic tier-1 tests (marker: suspend); ci/faults.sh reruns the churn
+soak in its pool-churn lane (REPEAT iterations + RACECHECK=1).
+"""
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.core import Container, Event, Node, Pod
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.cluster import SimCluster, SlicePool, seeded_pool_bad_day
+from odh_kubeflow_tpu.cluster.slicepool import (
+    POOL_STATE_ANNOTATION,
+    POOL_STATE_WARM,
+    notebook_reclaims_total,
+    notebook_resume_seconds,
+    slice_pool_hits_total,
+    slice_pool_misses_total,
+)
+from odh_kubeflow_tpu.controllers import (
+    Config,
+    CullingReconciler,
+    NotebookReconciler,
+    ProbeStatusController,
+    SuspendResumeController,
+    constants as C,
+)
+from odh_kubeflow_tpu.probe import sim_agent_behavior
+from odh_kubeflow_tpu.runtime import Manager
+from odh_kubeflow_tpu.runtime.flightrecorder import recorder
+from odh_kubeflow_tpu.tpu import GKE_NODEPOOL_LABEL
+
+pytestmark = pytest.mark.suspend
+
+NS = "multiplex"
+
+FAST = Config(
+    enable_culling=True,
+    suspend_enabled=True,
+    cull_idle_time_min=1.0 / 60.0,  # 1.0 s idle threshold
+    idleness_check_period_min=0.1 / 60.0,
+    readiness_probe_period_s=0.15,
+    suspend_checkpoint_window_s=1.5,
+    suspend_checkpoint_retries=2,
+    suspend_checkpoint_backoff_s=0.05,
+    resume_timeout_s=20.0,
+    resume_max_attempts=4,
+    reclaim_pending_grace_s=0.3,
+)
+
+
+def build_env(config=FAST, slices=2, duty=0.9, kernels_busy=True):
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=slices)
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, config).setup()
+    ProbeStatusController(mgr, config, http_get=cluster.http_get).setup()
+    CullingReconciler(mgr, config, http_get=cluster.http_get).setup()
+    SuspendResumeController(mgr, config, http_get=cluster.http_get).setup()
+    agents = {}
+    cluster.add_pod_behavior(
+        sim_agent_behavior(agents, duty=duty, kernels_busy=kernels_busy)
+    )
+    mgr.start()
+    return cluster, mgr, agents
+
+
+@pytest.fixture()
+def env():
+    # busy by default: suspension is test-triggered (idle scripting or stop)
+    cluster, mgr, agents = build_env()
+    yield cluster, mgr, agents
+    mgr.stop()
+    cluster.stop()
+    cluster.faults.clear()
+
+
+def mk_nb(name, priority=0, labels=None):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = NS
+    if labels:
+        nb.metadata.labels.update(labels)
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    nb.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2", priority=priority)
+    return nb
+
+
+def wait_for(fn, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def get_nb(cluster, name):
+    return cluster.client.get(Notebook, NS, name)
+
+
+def suspend_state(cluster, name):
+    return get_nb(cluster, name).metadata.annotations.get(
+        C.TPU_SUSPEND_STATE_ANNOTATION, ""
+    )
+
+
+def mesh_ready(cluster, name):
+    nb = get_nb(cluster, name)
+    return nb.status.tpu is not None and nb.status.tpu.mesh_ready
+
+
+def active(cluster, name):
+    nb = get_nb(cluster, name)
+    return (
+        C.STOP_ANNOTATION not in nb.metadata.annotations
+        and not nb.metadata.annotations.get(C.TPU_SUSPEND_STATE_ANNOTATION)
+        and mesh_ready(cluster, name)
+    )
+
+
+def pods_of(cluster, name):
+    return [
+        p
+        for p in cluster.client.list(
+            Pod, namespace=NS, labels={C.NOTEBOOK_NAME_LABEL: name}
+        )
+        if not p.metadata.deletion_timestamp
+    ]
+
+
+def warm_pools(cluster):
+    pools = set()
+    for n in cluster.client.list(Node):
+        if n.metadata.annotations.get(POOL_STATE_ANNOTATION) == POOL_STATE_WARM:
+            pools.add(n.metadata.labels.get(GKE_NODEPOOL_LABEL))
+    return pools
+
+
+def patch_persistent(cluster, name, patch, attempts=40):
+    """Scenario-driver writes must land even while a seeded bad day throws
+    409/429 at everything (the SimCluster._retry_persistent idiom) — the
+    fault being scripted must not eat the script."""
+    from odh_kubeflow_tpu.apimachinery import ConflictError, TooManyRequestsError
+
+    for i in range(attempts):
+        try:
+            cluster.client.patch(Notebook, NS, name, patch)
+            return
+        except (ConflictError, TooManyRequestsError):
+            if i == attempts - 1:
+                raise
+            time.sleep(0.02)
+
+
+def stop(cluster, name):
+    """A suspend-aware stop: the checkpointing stamp rides the same patch as
+    the stop annotation (exactly what the culler writes), so the scale-down
+    can never race the checkpoint window."""
+    patch_persistent(
+        cluster, name,
+        {"metadata": {"annotations": {
+            C.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+            C.TPU_SUSPEND_STATE_ANNOTATION: "checkpointing",
+        }}},
+    )
+
+
+def unstop(cluster, name):
+    patch_persistent(
+        cluster, name,
+        {"metadata": {"annotations": {C.STOP_ANNOTATION: None}}},
+    )
+
+
+def has_event(cluster, reason, involved=None):
+    for e in cluster.client.list(Event, namespace=NS):
+        if e.reason != reason:
+            continue
+        if involved is None or e.involved_object.name == involved:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# cull -> checkpoint -> warm pool release
+# ---------------------------------------------------------------------------
+
+
+def test_cull_checkpoints_and_releases_warm_pool(env):
+    cluster, mgr, agents = env
+    cluster.client.create(mk_nb("idler"))
+    wait_for(lambda: mesh_ready(cluster, "idler"), msg="bring-up")
+
+    hook_calls = []
+    agents["idler-0"].checkpoint_hook = (
+        lambda: hook_calls.append(1) or {"step": 42}
+    )
+    # the slice and the kernels both go quiet -> the CULLER fires, and with
+    # suspend enabled its stop patch carries the checkpointing stamp
+    agents["idler-0"].monitor.duty = 0.0
+    agents["idler-0"].kernels.set_idle(time.time() - 3600)
+
+    wait_for(
+        lambda: suspend_state(cluster, "idler") == "suspended",
+        msg="culled into Suspended",
+    )
+    nb = get_nb(cluster, "idler")
+    # checkpoint-before-suspend contract: the hook ran and the acked step is
+    # durable for the resume to restore
+    assert hook_calls, "checkpoint hook never driven during the suspend window"
+    assert nb.metadata.annotations.get(C.TPU_CHECKPOINT_SAVED_ANNOTATION) == "42"
+    assert C.STOP_ANNOTATION in nb.metadata.annotations
+    # the slice was released WARM, not torn down into general capacity
+    assert warm_pools(cluster), "no warm pool entry after suspension"
+    wait_for(lambda: has_event(cluster, "NotebookSuspended", "idler"),
+             msg="NotebookSuspended event")
+    # replicas went to 0 only after the window: pods drain now
+    wait_for(lambda: not pods_of(cluster, "idler"), msg="pods gone")
+    assert mgr.healthz()
+
+
+# ---------------------------------------------------------------------------
+# warm-hit resume (+ the idle-clock re-arm regression)
+# ---------------------------------------------------------------------------
+
+
+def test_warm_hit_resume_and_idle_clock_rearm(env):
+    cluster, mgr, agents = env
+    hits0 = slice_pool_hits_total.value()
+    resumes0 = notebook_resume_seconds._totals.get((), 0)
+    cluster.client.create(mk_nb("sleeper"))
+    wait_for(lambda: mesh_ready(cluster, "sleeper"), msg="bring-up")
+    agents["sleeper-0"].checkpoint_hook = lambda: {"step": 7}
+
+    stop(cluster, "sleeper")
+    wait_for(
+        lambda: suspend_state(cluster, "sleeper") == "suspended"
+        and not pods_of(cluster, "sleeper"),
+        msg="suspended, slice released",
+    )
+    assert warm_pools(cluster)
+
+    # the preserved pre-suspend last-activity: hours old. Without the re-arm
+    # a just-resumed notebook reads as instantly cullable.
+    patch_persistent(
+        cluster, "sleeper",
+        {"metadata": {"annotations": {
+            C.LAST_ACTIVITY_ANNOTATION: "2020-01-01T00:00:00Z",
+        }}},
+    )
+
+    t_unstop = time.time()
+    unstop(cluster, "sleeper")
+    wait_for(lambda: active(cluster, "sleeper"), msg="resumed to Active")
+
+    # warm pool hit: the claim bound the mesh-formed slice
+    assert slice_pool_hits_total.value() - hits0 >= 1
+    assert notebook_resume_seconds._totals.get((), 0) - resumes0 >= 1
+    # (wait_for: the event write lands one hop after the state clears)
+    wait_for(lambda: has_event(cluster, "NotebookResumed", "sleeper"),
+             msg="NotebookResumed event")
+    nb = get_nb(cluster, "sleeper")
+    # resume wound the machine fully down and UNCLAIMED the nodes
+    for key in (
+        C.TPU_SUSPEND_STATE_ANNOTATION,
+        C.TPU_RESUME_STARTED_ANNOTATION,
+        C.TPU_RESUME_ATTEMPTS_ANNOTATION,
+        C.TPU_SUSPENDED_AT_ANNOTATION,
+    ):
+        assert key not in nb.metadata.annotations
+    assert not any(
+        n.metadata.annotations.get(POOL_STATE_ANNOTATION)
+        for n in cluster.client.list(Node)
+    ), "pool marks leaked past resume completion"
+    # ISSUE 7 satellite: the idleness clock re-armed FROM RESUME TIME, not
+    # the preserved 2020 annotation (wait_for: a stale culler removal patch
+    # can race just past the re-arm; the next culler pass re-initializes)
+    from odh_kubeflow_tpu.apimachinery import parse_time
+
+    def rearmed():
+        ts = get_nb(cluster, "sleeper").metadata.annotations.get(
+            C.LAST_ACTIVITY_ANNOTATION
+        )
+        return bool(ts) and parse_time(ts).timestamp() >= t_unstop - 1.0
+
+    wait_for(rearmed, timeout=10, msg="idle clock re-armed from resume time")
+    # and the busy fresh agent keeps it alive: no instant re-cull
+    time.sleep(1.5)
+    assert C.STOP_ANNOTATION not in get_nb(cluster, "sleeper").metadata.annotations
+    assert mgr.healthz()
+
+
+# ---------------------------------------------------------------------------
+# pool miss -> cold fallback
+# ---------------------------------------------------------------------------
+
+
+def test_pool_miss_falls_back_to_cold_placement(env):
+    cluster, mgr, agents = env
+    misses0 = slice_pool_misses_total.value()
+    cluster.client.create(mk_nb("cold"))
+    wait_for(lambda: mesh_ready(cluster, "cold"), msg="bring-up")
+    stop(cluster, "cold")
+    wait_for(
+        lambda: suspend_state(cluster, "cold") == "suspended"
+        and not pods_of(cluster, "cold"),
+        msg="suspended",
+    )
+
+    # capacity pressure took the warm slice while the notebook slept: the
+    # pool entry is reclaimed back to general capacity
+    sp = SlicePool(cluster.client)
+    entry = sp.reclaim_idle("tpu-v5-lite-podslice", "2x2")
+    assert entry is not None, "expected an idle warm slice to reclaim"
+    assert notebook_reclaims_total.value(reason="pool-idle") >= 1
+    assert not warm_pools(cluster)
+
+    unstop(cluster, "cold")
+    wait_for(lambda: active(cluster, "cold"), msg="cold-fallback resume")
+    assert slice_pool_misses_total.value() - misses0 >= 1
+    wait_for(lambda: has_event(cluster, "NotebookResumed", "cold"),
+             msg="NotebookResumed event")
+    assert mgr.healthz()
+
+
+# ---------------------------------------------------------------------------
+# suspend aborted by the user returning mid-checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_user_return_mid_checkpoint_aborts_suspend():
+    # a LONG window (no checkpoint hook -> no acks -> the window runs to its
+    # deadline) so the user's return deterministically lands mid-checkpoint
+    # even on a starved machine
+    config = Config(
+        enable_culling=False,
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        suspend_checkpoint_window_s=10.0,
+        resume_timeout_s=8.0,
+        resume_max_attempts=4,
+    )
+    cluster, mgr, agents = build_env(config=config)
+    try:
+        cluster.client.create(mk_nb("comeback"))
+        wait_for(lambda: mesh_ready(cluster, "comeback"), msg="bring-up")
+        stop(cluster, "comeback")
+        wait_for(
+            lambda: suspend_state(cluster, "comeback") == "checkpointing",
+            msg="checkpoint window open",
+        )
+        unstop(cluster, "comeback")
+        wait_for(
+            lambda: suspend_state(cluster, "comeback") == ""
+            and active(cluster, "comeback"),
+            msg="suspend aborted, still Active",
+        )
+        wait_for(lambda: has_event(cluster, "SuspendAborted", "comeback"),
+                 msg="SuspendAborted event")
+        assert not warm_pools(cluster), (
+            "aborted suspend must not release the slice"
+        )
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-hook retries (satellite: one transient blip must not abort)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_survives_transient_probe_blips():
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=2)
+    blips = {"n": 0}
+
+    def flaky_http_get(url, timeout=10.0):
+        if "/tpu/checkpoint" in url and blips["n"] < 2:
+            # the first two checkpoint calls die at the transport — the old
+            # single-shot sweep would record no ack and suspend stateless
+            blips["n"] += 1
+            raise ConnectionError("injected transient probe blip")
+        return cluster.http_get(url, timeout=timeout)
+
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, FAST).setup()
+    ProbeStatusController(mgr, FAST, http_get=cluster.http_get).setup()
+    SuspendResumeController(mgr, FAST, http_get=flaky_http_get).setup()
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    mgr.start()
+    try:
+        cluster.client.create(mk_nb("flaky"))
+        wait_for(lambda: mesh_ready(cluster, "flaky"), msg="bring-up")
+        agents["flaky-0"].checkpoint_hook = lambda: {"step": 99}
+        stop(cluster, "flaky")
+        wait_for(
+            lambda: suspend_state(cluster, "flaky") == "suspended",
+            msg="suspended despite blips",
+        )
+        nb = get_nb(cluster, "flaky")
+        assert blips["n"] == 2, "the transient blips never fired"
+        # the retried sweep got through: the ack is durable
+        assert nb.metadata.annotations.get(C.TPU_CHECKPOINT_SAVED_ANNOTATION) == "99"
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# priority-based reclaim under oversubscription
+# ---------------------------------------------------------------------------
+
+
+def test_priority_reclaim_picks_lowest_and_spares_canary():
+    config = Config(
+        enable_culling=False,  # reclaim drives every suspension here
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        suspend_checkpoint_window_s=1.0,
+        resume_timeout_s=8.0,
+        resume_max_attempts=4,
+        reclaim_pending_grace_s=0.3,
+    )
+    cluster, mgr, agents = build_env(config=config, slices=3)
+    try:
+        reclaims0 = notebook_reclaims_total.value(reason="suspend")
+        recorder.clear()
+        # fill all three slices: low priority, mid priority, and the canary
+        # (lowest priority of all, but reclaim-exempt)
+        cluster.client.create(mk_nb("low", priority=1))
+        cluster.client.create(mk_nb("mid", priority=5))
+        cluster.client.create(
+            mk_nb("canary", priority=0,
+                  labels={C.TPU_RECLAIM_EXEMPT_LABEL: "true"})
+        )
+        for name in ("low", "mid", "canary"):
+            wait_for(lambda n=name: mesh_ready(cluster, n), msg=f"{name} up")
+        for name in ("low", "mid", "canary"):
+            agents[f"{name}-0"].checkpoint_hook = lambda: {"step": 1}
+
+        # a higher-priority notebook arrives into a full cluster
+        cluster.client.create(mk_nb("vip", priority=10))
+        wait_for(lambda: mesh_ready(cluster, "vip"), timeout=40,
+                 msg="vip placed via reclaim")
+
+        # the victim was the lowest-priority NON-EXEMPT notebook: "low", not
+        # the canary (priority 0 but exempt), and never "mid"
+        wait_for(
+            lambda: suspend_state(cluster, "low") == "suspended",
+            msg="low suspended cleanly",
+        )
+        low = get_nb(cluster, "low")
+        assert low.metadata.annotations.get(C.TPU_RECLAIM_ANNOTATION, "").startswith(
+            "capacity-pressure:"
+        )
+        # checkpoint-before-reclaim: state was saved before the slice moved
+        assert C.TPU_CHECKPOINT_SAVED_ANNOTATION in low.metadata.annotations
+        assert active(cluster, "mid"), "mid (higher priority) was touched"
+        assert active(cluster, "canary"), "the canary must never be a victim"
+        assert notebook_reclaims_total.value(reason="suspend") - reclaims0 >= 1
+        wait_for(lambda: has_event(cluster, "NotebookReclaimed", "low"),
+                 msg="NotebookReclaimed event")
+        # a reclaim is an incident: the flight recorder snapshotted it
+        assert any(i["reason"] == "reclaim" for i in recorder.incidents()), (
+            "no reclaim incident bundle captured"
+        )
+        # a reclaim-forced suspend releases to GENERAL capacity (the
+        # requester needed the chips), not back into the warm pool
+        assert not warm_pools(cluster)
+        assert mgr.healthz()
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# the webhook's reconciliation lock is NOT a stop
+# ---------------------------------------------------------------------------
+
+
+def test_reconciliation_lock_does_not_trigger_suspend():
+    """The webhook stamps `kubeflow-resource-stopped =
+    odh-notebook-controller-lock` at CREATE (reference idiom; the extension
+    controller clears it). The suspend machine must ignore the sentinel —
+    treating it as a stop ran a phantom suspend/resume episode at birth,
+    polluting the pool hit ratio and the resume-latency histogram with
+    bring-up time (caught by the full-operator verify drive, where the
+    webhook actually runs)."""
+    from odh_kubeflow_tpu.main import build_manager
+
+    config = Config(
+        enable_culling=False,
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        slo_enabled=False,
+    )
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=1)
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    mgr.start()
+    try:
+        resumes0 = notebook_resume_seconds._totals.get((), 0)
+        misses0 = slice_pool_misses_total.value()
+        cluster.client.create(mk_nb("fresh"))
+        wait_for(lambda: mesh_ready(cluster, "fresh"), msg="bring-up")
+        time.sleep(0.5)
+        nb = get_nb(cluster, "fresh")
+        assert not nb.metadata.annotations.get(
+            C.TPU_SUSPEND_STATE_ANNOTATION
+        ), "the reconciliation lock ran a phantom suspend episode"
+        assert notebook_resume_seconds._totals.get((), 0) == resumes0
+        assert slice_pool_misses_total.value() == misses0
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# ResumeFailed is terminal-but-self-healing (the RepairFailed idiom)
+# ---------------------------------------------------------------------------
+
+
+def test_resume_failed_is_explicit_and_self_heals():
+    config = Config(
+        enable_culling=False,
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        suspend_checkpoint_window_s=0.5,
+        resume_timeout_s=1.2,  # tiny budget: exhaustion is the point
+        resume_max_attempts=2,
+        reclaim_pending_grace_s=0.3,
+    )
+    cluster, mgr, agents = build_env(config=config, slices=1)
+    try:
+        recorder.clear()
+        cluster.client.create(mk_nb("trapped"))
+        wait_for(lambda: mesh_ready(cluster, "trapped"), msg="bring-up")
+        stop(cluster, "trapped")
+        wait_for(
+            lambda: suspend_state(cluster, "trapped") == "suspended"
+            and not pods_of(cluster, "trapped"),
+            msg="suspended",
+        )
+        # the ONLY slice vanishes while the notebook sleeps: nowhere to
+        # resume, warm or cold
+        sp = SlicePool(cluster.client)
+        assert sp.reclaim_idle("tpu-v5-lite-podslice", "2x2") is not None
+        nodes = [n.metadata.name for n in cluster.client.list(Node)]
+        for node in nodes:
+            cluster.preempt_node(node, grace_s=0.05)
+        unstop(cluster, "trapped")
+        # explicit terminal state, never a silent wedge
+        wait_for(
+            lambda: suspend_state(cluster, "trapped") == "resume-failed",
+            msg="explicit ResumeFailed",
+        )
+        wait_for(lambda: has_event(cluster, "ResumeFailed", "trapped"),
+                 msg="ResumeFailed event")
+        assert any(
+            i["reason"] == "resume-failed" for i in recorder.incidents()
+        ), "no resume-failed incident bundle captured"
+        # capacity returns -> the failed resume closes itself out
+        for node in nodes:
+            cluster.restore_node(node)
+        wait_for(lambda: active(cluster, "trapped"), timeout=40,
+                 msg="self-healed after capacity returned")
+        assert mgr.healthz()
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# the seeded churn soak: suspend/resume/reclaim cycling under a pool bad day
+# ---------------------------------------------------------------------------
+
+
+def _run_pool_churn(seed, cycles=2):
+    cluster, mgr, agents = build_env(slices=4)
+    try:
+        names = [f"churn-{i}" for i in range(3)]
+        for name in names:
+            cluster.client.create(mk_nb(name))
+        for name in names:
+            wait_for(lambda n=name: mesh_ready(cluster, n), msg=f"{name} up")
+        for name in names:
+            agents[f"{name}-0"].checkpoint_hook = lambda: {"step": 5}
+
+        plan = None
+        for cycle in range(cycles):
+            for name in names:
+                stop(cluster, name)
+            for name in names:
+                wait_for(
+                    lambda n=name: suspend_state(cluster, n) == "suspended"
+                    and not pods_of(cluster, n),
+                    timeout=40, msg=f"{name} suspended (cycle {cycle})",
+                )
+            if cycle == 0:
+                # bad day lands exactly on the warm pool: seeded poisoning of
+                # warm hosts + reclaim-race conflict storms + the usual
+                # control-plane schedule
+                warm_nodes = [
+                    n.metadata.name
+                    for n in cluster.client.list(Node)
+                    if n.metadata.annotations.get(POOL_STATE_ANNOTATION)
+                    == POOL_STATE_WARM
+                ]
+                plan = seeded_pool_bad_day(cluster, seed=seed,
+                                           warm_nodes=warm_nodes)
+                assert plan["poisoned"], "the seeded schedule poisoned nothing"
+            for name in names:
+                unstop(cluster, name)
+            if cycle == 0 and plan is not None:
+                # maintenance ends mid-resume: poisoned hosts come back so
+                # every resume can land even when the pool drained
+                time.sleep(1.0)
+                for node in plan["poisoned"]:
+                    cluster.restore_node(node)
+            # THE invariant: nobody is silently stuck in Resuming — every
+            # notebook returns to Active (a ResumeFailed would also fail
+            # this wait, which is the point: the soak demands zero failures)
+            for name in names:
+                wait_for(
+                    lambda n=name: active(cluster, n),
+                    timeout=60, msg=f"{name} resumed (cycle {cycle})",
+                )
+                assert not has_event(cluster, "ResumeFailed", name)
+        assert mgr.healthz(), "a controller thread died during the churn"
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+def test_seeded_pool_churn_no_silent_stuck():
+    _run_pool_churn(seed=0x5EED)
+
+
+@pytest.mark.slow
+def test_pool_churn_second_seed():
+    _run_pool_churn(seed=0xBADC0DE, cycles=3)
+
+
+# ---------------------------------------------------------------------------
+# the oversubscription acceptance soak: demand > physical chips, zero
+# terminal failures, at least one reclaim incident bundle
+# ---------------------------------------------------------------------------
+
+
+def test_oversubscription_soak_degrades_by_suspending_not_failing():
+    config = Config(
+        enable_culling=False,
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        suspend_checkpoint_window_s=1.0,
+        # generous resume budget: the soak asserts ZERO ResumeFailed, and a
+        # starved CI machine must not manufacture one out of scheduler lag
+        resume_timeout_s=30.0,
+        resume_max_attempts=6,
+        reclaim_pending_grace_s=0.3,
+        chip_budget=24,  # 6 x v5e-4 admitted over 8 physical chips
+    )
+    cluster, mgr, agents = build_env(config=config, slices=2)  # 8 chips
+    try:
+        recorder.clear()
+        # 5 notebooks x 4 chips = 20 chips demanded over 8 physical, inside
+        # the 24-chip budget. Ascending priority: each arrival reclaims the
+        # then-lowest.
+        def settled(name):
+            state = suspend_state(cluster, name)
+            if state == "suspended":
+                return True
+            if state:
+                return False
+            return mesh_ready(cluster, name)
+
+        names = [(f"nb-{i}", i + 1) for i in range(5)]
+        created = []
+        for name, pri in names:
+            cluster.client.create(mk_nb(name, priority=pri))
+            created.append(name)
+            # settle between arrivals: one reclaim episode at a time, the
+            # way a real trickle of users arrives — every notebook so far
+            # must be running or cleanly suspended before the next lands
+            for n in created:
+                wait_for(lambda n=n: settled(n), timeout=60,
+                         msg=f"{n} neither running nor cleanly suspended "
+                             f"after {name} arrived")
+            for p in pods_of(cluster, name):
+                if p.metadata.name in agents:
+                    agents[p.metadata.name].checkpoint_hook = (
+                        lambda: {"step": 3}
+                    )
+
+        # zero terminal failures anywhere: that is the whole policy
+        assert not has_event(cluster, "ResumeFailed")
+        assert not has_event(cluster, "RepairFailed")
+        running = [n for n, _ in names if active(cluster, n)]
+        parked = [n for n, _ in names
+                  if suspend_state(cluster, n) == "suspended"]
+        assert len(running) + len(parked) == len(names)
+        # the guaranteed shape of the cascade (exact membership of the
+        # second slot can vary with drain/bind interleaving): the HIGHEST
+        # priority always runs, the LOWEST is always the first one parked
+        assert running, "nothing running after the cascade"
+        assert "nb-4" in running, f"highest priority not running: {running}"
+        assert "nb-0" in parked, f"lowest priority not parked: {parked}"
+        # at least one reclaim incident bundle at /debug/incidents
+        assert any(i["reason"] == "reclaim" for i in recorder.incidents())
+
+        # a user returns: capacity freed by deleting one runner, the
+        # suspended notebook resumes instead of failing
+        victim_runner = running[0]
+        comeback = parked[0]
+        cluster.client.delete(Notebook, NS, victim_runner)
+        unstop(cluster, comeback)
+        wait_for(lambda: active(cluster, comeback), timeout=60,
+                 msg=f"{comeback} resumed after capacity returned")
+        assert not has_event(cluster, "ResumeFailed")
+        assert mgr.healthz()
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
