@@ -35,6 +35,15 @@ echo "=== faults lane: RACECHECK=1 iteration ==="
 RACECHECK=1 python -m pytest tests/test_faults.py -q -m "faults and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
+# ...and one with the runtime INVARIANT monitor armed (utils/invcheck.py,
+# ISSUE 8): every store write re-judges machine-transition legality, the
+# pool-claim CAS contract, and the chip budget — a lost-update race that
+# lands an undeclared state transition fails AT THE WRITE, not as a
+# mysteriously wedged notebook three minutes later
+echo "=== faults lane: INVCHECK=1 iteration ==="
+INVCHECK=1 python -m pytest tests/test_faults.py -q -m "faults and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
 # slice chaos lane (ISSUE 4): preemption / chip / ICI faults through the
 # repair path — the seeded slice "bad day" asserts the acceptance invariant
 # (every faulted notebook returns to Ready with a slice.repair trace, or
@@ -50,6 +59,9 @@ done
 echo "=== slice chaos lane: RACECHECK=1 iteration ==="
 RACECHECK=1 python -m pytest tests/test_slice_repair.py -q -m "slice_repair and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
+echo "=== slice chaos lane: INVCHECK=1 iteration ==="
+INVCHECK=1 python -m pytest tests/test_slice_repair.py -q -m "slice_repair and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
 
 # pool-churn soak lane (ISSUE 7): the suspend/resume/reclaim cycle under the
 # seeded pool bad day (warm-host poisoning + reclaim-race conflict storms +
@@ -64,5 +76,8 @@ done
 echo "=== pool churn lane: RACECHECK=1 iteration ==="
 RACECHECK=1 python -m pytest tests/test_suspend.py -q -m "suspend and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
+echo "=== pool churn lane: INVCHECK=1 iteration ==="
+INVCHECK=1 python -m pytest tests/test_suspend.py -q -m "suspend and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck, incl. slice chaos + pool churn) ==="
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, incl. slice chaos + pool churn) ==="

@@ -16,14 +16,21 @@ invariants the generic linters cannot know about:
 - ``swallowed-exception``  no bare/blind except in reconcile, webhook or
                        probe paths (checkers/exceptions.py)
 - ``metric-convention`` / ``annotation-convention``  Prometheus naming and
-                       constants.py-sourced annotation keys
-                       (checkers/conventions.py)
+                       constants.py-sourced annotation keys (+ dead
+                       ``*_ANNOTATION`` constants) (checkers/conventions.py)
+- ``machine-conformance``  every write of a state annotation matches a
+                       transition declared in `machines.py` — the three
+                       annotation-durable machines as data
+                       (checkers/machine_conformance.py)
 
 Intentional exceptions are recorded inline with ``# lint: disable=<check>``
-pragmas (comma-separated check names, or ``all``); `ci/analysis.sh` runs the
-whole pass and fails on any unsuppressed finding. The runtime half of the
+pragmas (comma-separated check names, or ``all``) and budgeted in
+`ci/pragma_allowlist.txt`; `ci/analysis.sh` runs the whole pass and fails on
+any unsuppressed finding or unreviewed pragma. The runtime half of the
 tooling — the instrumented lock + cache write barrier that turns chaos runs
-into race runs — lives in `odh_kubeflow_tpu/utils/racecheck.py`.
+into race runs (`utils/racecheck.py`), the INVCHECK store-write invariant
+monitor (`utils/invcheck.py`), and the systematic interleaving explorer
+(`explore.py`) — shares the `machines.py` specs with the static checker.
 """
 from .framework import (  # noqa: F401
     Checker,

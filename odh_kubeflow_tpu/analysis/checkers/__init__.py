@@ -8,6 +8,7 @@ from .cache_mutation import CacheMutationChecker
 from .conventions import AnnotationConventionChecker, MetricConventionChecker
 from .exceptions import SwallowedExceptionChecker
 from .lock_discipline import LockDisciplineChecker, LockOrderChecker
+from .machine_conformance import MachineConformanceChecker
 
 
 def make_checkers() -> List[Checker]:
@@ -21,4 +22,5 @@ def make_checkers() -> List[Checker]:
         SwallowedExceptionChecker(),
         MetricConventionChecker(),
         AnnotationConventionChecker(),
+        MachineConformanceChecker(),
     ]

@@ -17,13 +17,20 @@ in controllers/constants.py (and utils/tracing.py, the traceparent key's
 canonical home). Everywhere else must import the constant — the reference
 keeps these byte-identical to upstream, and a typo'd inline key silently
 breaks the stop/culling state machine rather than failing loudly.
+
+The checker's `finish()` pass additionally flags DEAD `*_ANNOTATION`
+constants: a key defined in constants.py that no other module reads is
+either a leftover from a removed feature (delete it) or — worse — a
+contract someone believes is honored while nothing writes or reads it
+(ISSUE 8 satellite; first catch: TPU_IDLE_ANNOTATION, which nothing ever
+consumed — the culler reads last_busy from the probe JSON).
 """
 from __future__ import annotations
 
 import ast
 import re
 from pathlib import Path
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..framework import Checker, Finding, ModuleInfo
 from ..metric_rules import check_metric
@@ -112,8 +119,31 @@ class MetricConventionChecker(Checker):
 class AnnotationConventionChecker(Checker):
     name = "annotation-convention"
 
+    def __init__(self) -> None:
+        # constants.py `*_ANNOTATION` definitions and the names read
+        # anywhere else, for the dead-constant finish() pass. Only armed
+        # when the real constants module is in the scan set, so fixture
+        # runs on a lone snippet stay silent.
+        self._defined: Dict[str, Tuple[str, int]] = {}
+        self._read: set = set()
+
     def check(self, module: ModuleInfo) -> Iterable[Finding]:
-        if Path(module.path).name in ANNOTATION_HOMES:
+        basename = Path(module.path).name
+        if basename == "constants.py" and "controllers" in Path(module.path).parts:
+            for node in ast.iter_child_nodes(module.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for target in node.targets:
+                    if isinstance(target, ast.Name) and \
+                            target.id.endswith("_ANNOTATION"):
+                        self._defined[target.id] = (module.path, node.lineno)
+        else:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Attribute):
+                    self._read.add(node.attr)
+                elif isinstance(node, ast.Name):
+                    self._read.add(node.id)
+        if basename in ANNOTATION_HOMES:
             return []
         findings: List[Finding] = []
         for node in ast.walk(module.tree):
@@ -132,3 +162,21 @@ class AnnotationConventionChecker(Checker):
                     )
                 )
         return findings
+
+    def finish(self) -> Iterable[Finding]:
+        if not self._read:
+            # constants.py scanned alone (a single-file --check run): with
+            # no reader module in the scan set, "nothing reads it" would be
+            # vacuously true for every constant — stay silent
+            return
+        for name, (path, line) in sorted(self._defined.items()):
+            if name not in self._read:
+                yield Finding(
+                    check=self.name,
+                    path=path,
+                    line=line,
+                    message=f"dead annotation constant {name}: no module "
+                    "reads it — delete it, or the feature that honored "
+                    "this contract is gone while the key suggests "
+                    "otherwise",
+                )

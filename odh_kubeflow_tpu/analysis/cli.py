@@ -7,6 +7,13 @@
                                     # naming rules (ci/metrics_lint.sh lane)
     python -m odh_kubeflow_tpu.analysis --slo-lint            # SLO/alert defs
                                     # vs live registry (ci/slo_lint.sh lane)
+    python -m odh_kubeflow_tpu.analysis --pragma-gate ci/pragma_allowlist.txt
+                                    # fail on unreviewed `# lint: disable`
+    python -m odh_kubeflow_tpu.analysis --pragma-update ci/pragma_allowlist.txt
+    python -m odh_kubeflow_tpu.analysis --machines-doc        # render the
+                                    # machine specs (ARCHITECTURE round 9)
+    python -m odh_kubeflow_tpu.analysis --explore             # bounded
+                                    # exhaustive interleaving run (ISSUE 8)
 
 Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
 """
@@ -17,7 +24,14 @@ import sys
 from pathlib import Path
 from typing import List, Optional
 
-from .framework import all_checkers, run_analysis
+from .framework import (
+    all_checkers,
+    collect_pragmas,
+    parse_pragma_allowlist,
+    pragma_budget_violations,
+    render_pragma_allowlist,
+    run_analysis,
+)
 
 
 def _registry_lint() -> int:
@@ -80,6 +94,93 @@ def _slo_lint() -> int:
     return 0
 
 
+def _default_paths() -> List[str]:
+    # resolve from the installed package location, not the cwd — the same
+    # tree is scanned no matter where the command is invoked from
+    import odh_kubeflow_tpu
+
+    return [str(Path(odh_kubeflow_tpu.__file__).parent)]
+
+
+def _pragma_gate(paths: List[str], allowlist_path: str, update: bool) -> int:
+    if update and paths:
+        # an update from a subset of the tree would silently DROP every
+        # reviewed entry outside it — the allowlist is whole-tree only
+        print(
+            "--pragma-update rebuilds the allowlist for the WHOLE tree; "
+            "explicit paths would drop reviewed entries outside them — "
+            "run it without path arguments",
+            file=sys.stderr,
+        )
+        return 2
+    # the committed allowlist stores repo-root-relative paths; normalize the
+    # collected keys the same way so the gate is cwd-independent (the repo
+    # root is derived from the installed package, never from cwd)
+    import odh_kubeflow_tpu
+
+    repo_root = Path(odh_kubeflow_tpu.__file__).resolve().parent.parent
+    raw_budget = collect_pragmas(paths or _default_paths())
+    budget = {}
+    for (path, check), count in raw_budget.items():
+        resolved = Path(path).resolve()
+        try:
+            path = str(resolved.relative_to(repo_root))
+        except ValueError:
+            path = str(resolved)
+        budget[(path, check)] = budget.get((path, check), 0) + count
+    if update:
+        Path(allowlist_path).write_text(render_pragma_allowlist(budget))
+        print(f"pragma allowlist updated: {len(budget)} (path, check) "
+              f"entries -> {allowlist_path}")
+        return 0
+    try:
+        allowlist = parse_pragma_allowlist(Path(allowlist_path).read_text())
+    except FileNotFoundError:
+        print(f"pragma gate FAILED: allowlist {allowlist_path} missing "
+              "(generate it with --pragma-update)", file=sys.stderr)
+        return 1
+    problems = pragma_budget_violations(budget, allowlist)
+    if problems:
+        print("pragma gate FAILED (unreviewed suppressions):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    stale = sum(
+        1 for key, allowed in allowlist.items() if budget.get(key, 0) < allowed
+    )
+    print(
+        f"pragma gate OK: {sum(budget.values())} pragma(s) across "
+        f"{len(budget)} (path, check) entries, all reviewed"
+        + (f" ({stale} allowlist entr{'y' if stale == 1 else 'ies'} stale — "
+           "refresh with --pragma-update)" if stale else "")
+    )
+    return 0
+
+
+def _explore() -> int:
+    """The bounded-exhaustive interleaving run over the shipped
+    controllers (the --machines lane's dynamic half)."""
+    import logging
+
+    logging.disable(logging.CRITICAL)
+    from .explore import explore_default
+
+    result = explore_default()
+    print(
+        f"explorer: {result.schedules} quiesced schedules, "
+        f"{result.visited} scheduler states ({result.pruned} pruned), "
+        f"truncated={result.truncated}, exhausted={result.exhausted}"
+    )
+    for v in result.violations:
+        print(f"  VIOLATION [{v.invariant}] {v.detail}")
+        print(f"    trace: {' -> '.join(v.trace)}")
+    if not result.ok:
+        print("explorer FAILED: interleaving space not clean/exhausted")
+        return 1
+    print("explorer OK: zero invariant violations over the explored space")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m odh_kubeflow_tpu.analysis",
@@ -106,6 +207,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="lint SLO/alert-rule definitions against the live registry "
         "(the ci/slo_lint.sh lane)",
     )
+    parser.add_argument(
+        "--pragma-gate", metavar="ALLOWLIST",
+        help="fail when the tree carries `# lint: disable` pragmas beyond "
+        "the committed allowlist (ci/pragma_allowlist.txt)",
+    )
+    parser.add_argument(
+        "--pragma-update", metavar="ALLOWLIST",
+        help="rewrite the pragma allowlist from the current tree (after "
+        "review)",
+    )
+    parser.add_argument(
+        "--machines-doc", action="store_true",
+        help="render the state-machine specs (analysis/machines.py) as the "
+        "markdown contract ARCHITECTURE.md embeds",
+    )
+    parser.add_argument(
+        "--explore", action="store_true",
+        help="run the bounded exhaustive interleaving exploration over the "
+        "shipped controllers (analysis/explore.py)",
+    )
     args = parser.parse_args(argv)
 
     if args.list_checks:
@@ -116,16 +237,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _registry_lint()
     if args.slo_lint:
         return _slo_lint()
+    if args.pragma_gate or args.pragma_update:
+        return _pragma_gate(
+            args.paths,
+            args.pragma_update or args.pragma_gate,
+            update=bool(args.pragma_update),
+        )
+    if args.machines_doc:
+        from .machines import render_markdown
 
-    if args.paths:
-        paths = args.paths
-    else:
-        # resolve the default from the installed package location, not the
-        # cwd — `python -m odh_kubeflow_tpu.analysis` must scan the same
-        # tree no matter where it is invoked from
-        import odh_kubeflow_tpu
+        print(render_markdown())
+        return 0
+    if args.explore:
+        return _explore()
 
-        paths = [str(Path(odh_kubeflow_tpu.__file__).parent)]
+    paths = args.paths or _default_paths()
     checkers = all_checkers()
     if args.check:
         known = {c.name for c in checkers}

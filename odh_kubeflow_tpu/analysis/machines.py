@@ -1,0 +1,373 @@
+"""The three durable state machines, declared as DATA — the verification
+contract (ISSUE 8).
+
+PRs 4 and 7 grew three interacting annotation-durable machines whose
+contracts (repair stands down while suspend owns a slice, the culler's stop
+stamp rides atomically with `suspend-state=checkpointing`, reclaim never
+victimizes the canary) were enforced only by example-based tests. These
+specs are the single source of truth three consumers share:
+
+- the `machine-conformance` static checker (checkers/machine_conformance.py)
+  AST-extracts every write of the state annotations from `controllers/` and
+  flags writes that are not a declared transition,
+- the INVCHECK=1 runtime monitor (utils/invcheck.py) validates every
+  OBSERVED old->new state change at the store against the same transitions,
+- `render_markdown()` renders the canonical contract tables embedded in
+  ARCHITECTURE.md (round 9) — docs can no longer drift from the code
+  because both are generated from this module.
+
+State names are the literal annotation VALUES; `""` is the cleared/absent
+key (each machine's rest state). A transition's `via` is the
+`module.py:function` whose AST contains the write — `None` marks an
+external actor (the user's unstop is a kubectl patch, not our code).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class State:
+    name: str  # annotation value; "" = key absent (rest state)
+    title: str
+    doc: str = ""
+    terminal: bool = False
+    # terminal escape hatches (a terminal state with neither is a dead end
+    # the conformance checker flags): self_healing = a declared transition
+    # leaves it; incident = entering it snapshots a flight-recorder bundle
+    self_healing: bool = False
+    incident: bool = False
+
+
+@dataclass(frozen=True)
+class Transition:
+    src: str  # state name, or "*" (defensive clear from any state)
+    dst: str
+    # "module.py:function" containing the write; None = external actor
+    via: Optional[str]
+    trigger: str = ""
+
+
+@dataclass(frozen=True)
+class MachineSpec:
+    name: str
+    # constant NAME in controllers/constants.py holding the annotation key
+    annotation: str
+    owner: str  # owning controller module (basename)
+    states: Tuple[State, ...]
+    transitions: Tuple[Transition, ...]
+    doc: str = ""
+    # annotation VALUE -> state name, for values that are not state names
+    # themselves (the webhook's reconciliation-lock sentinel)
+    value_states: Dict[str, str] = field(default_factory=dict)
+    # the state a non-literal (computed) write maps to, e.g. the culler's
+    # `now_rfc3339()` stop timestamp; None = computed writes are findings
+    dynamic_state: Optional[str] = None
+
+    def state(self, name: str) -> Optional[State]:
+        for s in self.states:
+            if s.name == name:
+                return s
+        return None
+
+    def writer_modules(self) -> Tuple[str, ...]:
+        return tuple(sorted({
+            t.via.split(":", 1)[0] for t in self.transitions if t.via
+        }))
+
+    def classify_value(self, value: Optional[str], dynamic: bool = False
+                       ) -> Optional[str]:
+        """Map a written annotation value to a state name; None = unmappable
+        (an undeclared state — a conformance finding)."""
+        if dynamic:
+            return self.dynamic_state
+        if value is None:
+            value = ""
+        if value in self.value_states:
+            return self.value_states[value]
+        if self.state(value) is not None:
+            return value
+        return None
+
+    def allows(self, src: Optional[str], dst: str) -> bool:
+        """Is src->dst a declared transition? src=None means 'unknown source'
+        (the static checker cannot see it): any declared inbound edge to dst
+        counts. Same-state writes are always legal (level-triggered
+        controllers re-assert)."""
+        if src is not None and src == dst:
+            return True
+        for t in self.transitions:
+            if t.dst != dst:
+                continue
+            if src is None or t.src == src or t.src == "*":
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# suspend/resume (controllers/suspend.py, PR 7)
+# ---------------------------------------------------------------------------
+
+SUSPEND_MACHINE = MachineSpec(
+    name="suspend",
+    annotation="TPU_SUSPEND_STATE_ANNOTATION",
+    owner="suspend.py",
+    doc="Checkpointed capacity multiplexing: cull/stop checkpoints kernel "
+        "state and releases the slice warm; unstop resumes from the pool.",
+    states=(
+        State("", "Active", "no suspend episode; slice owned by its pods"),
+        State("checkpointing", "Checkpointing",
+              "stop stamped; replicas held while every ready host's "
+              "/tpu/checkpoint hook is driven inside a bounded window"),
+        State("suspended", "Suspended",
+              "slice released (warm pool, or general capacity when "
+              "reclaim-forced); replicas 0"),
+        State("resuming", "Resuming",
+              "unstopped; warm claim bound or cold fallback placing"),
+        State("resume-failed", "ResumeFailed",
+              "attempts exhausted; the reclaimer keeps watching",
+              terminal=True, self_healing=True, incident=True),
+    ),
+    transitions=(
+        Transition("", "checkpointing", "culling.py:reconcile",
+                   "cull: the checkpointing stamp rides the SAME patch as "
+                   "the stop annotation"),
+        Transition("", "checkpointing", "suspend.py:reconcile",
+                   "user stop without the culler's atomic stamp"),
+        Transition("", "checkpointing", "suspend.py:_maybe_reclaim_for",
+                   "oversubscription reclaim: victim checkpoint-suspends"),
+        Transition("checkpointing", "suspended",
+                   "suspend.py:_run_checkpoint_window",
+                   "window closed (all ready hosts acked, or deadline)"),
+        Transition("checkpointing", "", "suspend.py:_clear_updates",
+                   "abort: notebook unstopped during the window"),
+        Transition("suspended", "resuming", "suspend.py:_begin_resume",
+                   "unstop: warm claim or cold fallback"),
+        Transition("resuming", "suspended", "suspend.py:reconcile",
+                   "re-stopped mid-resume: park; claims return to warm"),
+        Transition("resume-failed", "suspended", "suspend.py:reconcile",
+                   "re-stopped after a failed resume"),
+        Transition("resuming", "", "suspend.py:_clear_updates",
+                   "mesh ready: resume complete; idle clock re-arms"),
+        Transition("resuming", "resume-failed", "suspend.py:_fail_resume",
+                   "attempts exhausted"),
+        Transition("resume-failed", "", "suspend.py:_clear_updates",
+                   "self-heal: capacity returned and the mesh formed"),
+        Transition("*", "", "suspend.py:reconcile",
+                   "defensive clear of an unknown state value"),
+    ),
+)
+
+# ---------------------------------------------------------------------------
+# slice repair (controllers/slice_repair.py, PR 4)
+# ---------------------------------------------------------------------------
+
+REPAIR_MACHINE = MachineSpec(
+    name="slice-repair",
+    annotation="TPU_REPAIR_STATE_ANNOTATION",
+    owner="slice_repair.py",
+    doc="Survive the accelerator layer: checkpoint-before-evict, whole-gang "
+        "re-placement, bounded retry. Stands down whenever the suspend "
+        "machine owns the slice (any suspend-state, or the stop annotation).",
+    states=(
+        State("", "Ready", "no repair episode"),
+        State("degraded", "Degraded",
+              "fault detected; checkpoint-before-evict window open"),
+        State("repairing", "Repairing",
+              "gang evicted; waiting for all-or-nothing re-placement"),
+        State("failed", "RepairFailed",
+              "attempts exhausted; operator attention required",
+              terminal=True, self_healing=True, incident=True),
+    ),
+    transitions=(
+        Transition("", "degraded", "slice_repair.py:_enter_degraded",
+                   "node taint/NotReady, chip/ICI fault, or unreachable "
+                   "hosts past the dwell"),
+        Transition("degraded", "repairing",
+                   "slice_repair.py:_run_checkpoint_window",
+                   "checkpoint window closed; gang evicted"),
+        Transition("repairing", "failed", "slice_repair.py:_fail",
+                   "attempts exhausted"),
+        Transition("repairing", "", "slice_repair.py:_clear_updates",
+                   "slice healthy again; MTTR observed"),
+        Transition("degraded", "", "slice_repair.py:_clear_updates",
+                   "abort: notebook stopped or suspend machine took over"),
+        Transition("failed", "", "slice_repair.py:_clear_updates",
+                   "self-heal: capacity returned and the slice recovered"),
+        Transition("*", "", "slice_repair.py:reconcile",
+                   "defensive clear of an unknown state value"),
+    ),
+)
+
+# ---------------------------------------------------------------------------
+# culling / probe-gate stop machine (kubeflow-resource-stopped)
+# ---------------------------------------------------------------------------
+
+CULLING_MACHINE = MachineSpec(
+    name="culling",
+    annotation="STOP_ANNOTATION",
+    owner="culling.py",
+    doc="The reference's stop/culling contract: the stop annotation scales "
+        "the slice away; the webhook's reconciliation lock rides the SAME "
+        "key with a sentinel value until the extension controller clears it.",
+    states=(
+        State("", "Running", "no stop annotation; slice live"),
+        State("locked", "ReconciliationLock",
+              "webhook handshake: replicas held at 0 until the extension "
+              "controller finishes bring-up"),
+        State("stopped", "Stopped",
+              "culled or user-stopped; replicas scale to 0 (or the suspend "
+              "machine checkpoints first)"),
+    ),
+    transitions=(
+        Transition("", "locked", "webhook.py:inject_reconciliation_lock",
+                   "CREATE admission stamps the lock sentinel"),
+        Transition("locked", "", "extension.py:remove_reconciliation_lock",
+                   "extension controller releases the handshake"),
+        Transition("", "stopped", "culling.py:reconcile",
+                   "idle (Jupyter AND TPU duty-cycle agree): cull"),
+        Transition("", "stopped", "suspend.py:_maybe_reclaim_for",
+                   "oversubscription reclaim stops the victim"),
+        Transition("stopped", "", None,
+                   "user unstop (kubectl annotate / UI) — external actor"),
+        Transition("locked", "stopped", None,
+                   "user stop during bring-up overwrites the lock sentinel "
+                   "— external actor"),
+    ),
+    value_states={"odh-notebook-controller-lock": "locked"},
+    dynamic_state="stopped",  # the stop value is the cull/stop timestamp
+)
+
+# ---------------------------------------------------------------------------
+# warm-pool node machine (cluster/slicepool.py) — NOT statically checked
+# (its annotations live on Nodes and their canonical home is slicepool.py);
+# declared here so the INVCHECK monitor and the explorer validate observed
+# Node pool-state transitions against the same kind of contract
+# ---------------------------------------------------------------------------
+
+POOL_MACHINE = MachineSpec(
+    name="slice-pool",
+    annotation="POOL_STATE_ANNOTATION",
+    owner="slicepool.py",
+    doc="Node-durable warm pool: release holds a suspended slice warm; "
+        "claims CAS through the lead node's resourceVersion.",
+    states=(
+        State("", "GeneralCapacity", "no pool mark; the scheduler owns it"),
+        State("warm", "Warm", "held for resume binds; scheduler places "
+              "nobody here"),
+        State("claimed", "Claimed", "a resuming notebook owns the bind "
+              "window; only the claimant's pods may land"),
+    ),
+    transitions=(
+        Transition("", "warm", "slicepool.py:release",
+                   "suspend released the slice warm"),
+        Transition("warm", "claimed", "slicepool.py:claim",
+                   "resume won the lead-node CAS"),
+        Transition("", "claimed", "slicepool.py:claim",
+                   "follower re-stamp: the lead CAS already serialized the "
+                   "claim; a racing sweep may have cleared this follower"),
+        Transition("claimed", "warm", "slicepool.py:release",
+                   "claim abandoned (poisoned slice / raced reclaim): "
+                   "back to warm"),
+        Transition("warm", "", "slicepool.py:reclaim_idle",
+                   "idle warm slice reclaimed under capacity pressure"),
+        Transition("warm", "", "slicepool.py:_clear",
+                   "swept (poisoned / half-marked remnant)"),
+        Transition("claimed", "", "slicepool.py:_clear",
+                   "resume completed (unclaim) or swept"),
+    ),
+)
+
+# the three statically-checked machines (the ISSUE 8 contract) + the pool
+MACHINES: Tuple[MachineSpec, ...] = (
+    SUSPEND_MACHINE, REPAIR_MACHINE, CULLING_MACHINE,
+)
+ALL_MACHINES: Tuple[MachineSpec, ...] = MACHINES + (POOL_MACHINE,)
+
+
+def machine_for_annotation(const_name: str) -> Optional[MachineSpec]:
+    for spec in MACHINES:
+        if spec.annotation == const_name:
+            return spec
+    return None
+
+
+def spec_errors(spec: MachineSpec) -> Tuple[str, ...]:
+    """Data-level validation: dead/unreachable declared states and terminal
+    dead ends, before any code is consulted. Shared by the conformance
+    checker's finish() pass and the spec self-tests."""
+    errors = []
+    names = {s.name for s in spec.states}
+    if "" not in names:
+        errors.append(f"machine {spec.name!r}: no rest state ('') declared")
+    for t in spec.transitions:
+        for endpoint in (t.src, t.dst):
+            if endpoint != "*" and endpoint not in names:
+                errors.append(
+                    f"machine {spec.name!r}: transition {t.src or 'rest'!r}"
+                    f"->{t.dst or 'rest'!r} references undeclared state"
+                )
+    inbound = {t.dst for t in spec.transitions}
+    outbound = {t.src for t in spec.transitions}
+    for s in spec.states:
+        if s.name and s.name not in inbound:
+            errors.append(
+                f"machine {spec.name!r}: state {s.name!r} is unreachable "
+                "(no declared transition enters it)"
+            )
+        if s.terminal:
+            if not (s.self_healing or s.incident):
+                errors.append(
+                    f"machine {spec.name!r}: terminal state {s.name!r} has "
+                    "neither a self-heal path nor an incident bundle — a "
+                    "silent dead end"
+                )
+            if s.self_healing and s.name not in outbound:
+                errors.append(
+                    f"machine {spec.name!r}: state {s.name!r} claims "
+                    "self-healing but no declared transition leaves it"
+                )
+        elif s.name and s.name not in outbound and "*" not in outbound:
+            errors.append(
+                f"machine {spec.name!r}: non-terminal state {s.name!r} has "
+                "no exit transition (would wedge forever)"
+            )
+    return tuple(errors)
+
+
+def render_markdown(specs: Tuple[MachineSpec, ...] = ALL_MACHINES) -> str:
+    """The canonical contract tables ARCHITECTURE.md round 9 embeds
+    (python -m odh_kubeflow_tpu.analysis --machines-doc)."""
+    out = []
+    for spec in specs:
+        out.append(f"#### `{spec.name}` — `{spec.annotation}` "
+                   f"(owner: `{spec.owner}`)")
+        out.append("")
+        out.append(spec.doc)
+        out.append("")
+        out.append("| state | annotation value | terminal | notes |")
+        out.append("|---|---|---|---|")
+        for s in spec.states:
+            flags = []
+            if s.terminal:
+                flags.append("terminal")
+                if s.self_healing:
+                    flags.append("self-healing")
+                if s.incident:
+                    flags.append("incident bundle")
+            out.append(
+                f"| {s.title} | `{s.name or '(absent)'}` | "
+                f"{', '.join(flags) or '—'} | {s.doc} |"
+            )
+        out.append("")
+        out.append("| from | to | via | trigger |")
+        out.append("|---|---|---|---|")
+        for t in spec.transitions:
+            via = f"`{t.via}`" if t.via else "_external (user)_"
+            out.append(
+                f"| `{t.src or 'rest'}` | `{t.dst or 'rest'}` | {via} "
+                f"| {t.trigger} |"
+            )
+        out.append("")
+    return "\n".join(out)

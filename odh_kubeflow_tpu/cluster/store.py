@@ -40,7 +40,7 @@ from ..apimachinery import (
     match_labels,
     now_rfc3339,
 )
-from ..utils import racecheck
+from ..utils import invcheck, racecheck
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -291,11 +291,20 @@ class Store:
         backend: str = "auto",
         watch_history_limit: int = 4096,
         faults: Optional[Any] = None,
+        invariants: Optional[Any] = None,
     ):
         self.scheme = scheme
         # fault injection seam (cluster/faults.py FaultInjector); None in
         # production — every hook site is a single attribute check
         self.faults = faults
+        # INVCHECK seam (utils/invcheck.py Monitor): observed after every
+        # successful write with (old, new) so cross-object invariants and
+        # machine-transition legality are judged at the exact write that
+        # would break them. None in production (INVCHECK=1 arms it; the
+        # explorer injects a collecting monitor explicitly) — one attribute
+        # check per write when off, mirroring the faults seam.
+        self.invariants = invariants if invariants is not None \
+            else invcheck.store_monitor()
         if faults is not None:
             faults.bind_store(self)
         # instrumented under RACECHECK=1: the in-process admission chain
@@ -446,7 +455,13 @@ class Store:
             meta["creationTimestamp"] = now_rfc3339()
             meta.pop("deletionTimestamp", None)
             raw = bucket.store(key, obj)  # one serialization; never aliases obj
-            self._emit(av, kind, WatchEvent(ADDED, json.loads(raw)))
+            stored = json.loads(raw)
+            self._emit(av, kind, WatchEvent(ADDED, stored))
+            if self.invariants is not None:
+                # the monitor only reads; sharing the emitted snapshot (as
+                # every watcher queue already does) avoids a re-parse per
+                # armed write
+                self.invariants.observe(self, av, kind, None, stored)
             if self._gc_enabled and self._owner_dangling(obj):
                 # k8s GC-controller semantics, made synchronous like the
                 # cascade above: an object created with a DANGLING owner
@@ -494,6 +509,17 @@ class Store:
                     out.append(obj)
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
             return out
+
+    def peek_raw(
+        self, api_version: str, kind: str
+    ) -> List[Dict[str, Any]]:
+        """Invariant-monitor read view: every object of a kind WITHOUT the
+        fault-injection hook (an invariant re-judge must neither consume
+        count-based fault rules nor be failed by them) — re-entrant under
+        the store lock, so a monitor firing mid-write sees the state that
+        write just produced."""
+        with self._lock:
+            return list(self._bucket(api_version, kind).values())
 
     def list_raw_with_rv(
         self,
@@ -571,7 +597,15 @@ class Store:
                 gen += 1
             mmeta["generation"] = gen
             raw = bucket.store(key, merged)
-            self._emit(av, kind, WatchEvent(MODIFIED, json.loads(raw)))
+            stored = json.loads(raw)
+            self._emit(av, kind, WatchEvent(MODIFIED, stored))
+            if self.invariants is not None:
+                # old state re-parses current_raw: `current` may BE `merged`
+                # (status branch mutates it in place); `stored` is shared
+                # read-only with the emit above
+                self.invariants.observe(
+                    self, av, kind, json.loads(current_raw), stored
+                )
             self._finalize_if_ready(av, kind, bucket, key)
             # finalize may have removed the object; either way `raw` is the
             # state this update produced
@@ -609,10 +643,17 @@ class Store:
             meta = obj["metadata"]
             if meta.get("finalizers"):
                 if not meta.get("deletionTimestamp"):
+                    old = bucket[key] if self.invariants is not None else None
                     meta["deletionTimestamp"] = now_rfc3339()
                     meta["resourceVersion"] = self._next_rv()
                     bucket[key] = obj
                     self._emit(api_version, kind, WatchEvent(MODIFIED, obj))
+                    if self.invariants is not None:
+                        # the deletionTimestamp stamp is a write like any
+                        # other — the monitor's contract is EVERY write
+                        self.invariants.observe(
+                            self, api_version, kind, old, obj
+                        )
                 return
             self._remove(api_version, kind, bucket, key)
 
@@ -633,6 +674,8 @@ class Store:
         # watch resume from that RV does not replay the deletion
         obj.setdefault("metadata", {})["resourceVersion"] = self._next_rv()
         self._emit(api_version, kind, WatchEvent(DELETED, obj))
+        if self.invariants is not None:
+            self.invariants.observe(self, api_version, kind, obj, None)
         if self._gc_enabled:
             self._cascade_delete(obj)
 

@@ -102,7 +102,6 @@ TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"
 # a mirrored Event is never re-mirrored into an infinite loop
 TPU_MIRRORED_EVENT_ANNOTATION = "notebooks.tpu.kubeflow.org/mirrored"
 TPU_PROBE_PORT = 8889  # in-pod probe agent (readiness + utilization + activity)
-TPU_IDLE_ANNOTATION = "notebooks.tpu.kubeflow.org/tpu-last-busy"
 
 # -- finalizers (extension controller) --
 ROUTE_FINALIZER = "notebooks.tpu.kubeflow.org/route-cleanup"

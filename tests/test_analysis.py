@@ -27,6 +27,15 @@ from odh_kubeflow_tpu.analysis.checkers.lock_discipline import (
     LockDisciplineChecker,
     LockOrderChecker,
 )
+from odh_kubeflow_tpu.analysis.checkers.machine_conformance import (
+    MachineConformanceChecker,
+)
+from odh_kubeflow_tpu.analysis.framework import (
+    collect_pragmas,
+    parse_pragma_allowlist,
+    pragma_budget_violations,
+    render_pragma_allowlist,
+)
 from odh_kubeflow_tpu.analysis.metric_rules import check_metric, check_registry
 from odh_kubeflow_tpu.utils import racecheck
 
@@ -370,6 +379,224 @@ def test_annotation_convention_flags_inline_key():
 
 def test_annotation_convention_passes_constant_reference():
     assert run_on_source(ANNOTATION_CLEAN, [AnnotationConventionChecker()]) == []
+
+
+# ---------------------------------------------------------------------------
+# machine-conformance (ISSUE 8: the state-machine write contract)
+# ---------------------------------------------------------------------------
+
+MACHINE_ROGUE_WRITER = '''
+from . import constants as C
+def reconcile(self, nb):
+    self._patch_annotations(nb, {C.TPU_SUSPEND_STATE_ANNOTATION: "suspended"})
+'''
+
+MACHINE_UNDECLARED_STATE = '''
+from . import constants as C
+def _begin_resume(self, nb):
+    self._patch_annotations(nb, {C.TPU_SUSPEND_STATE_ANNOTATION: "warming-up"})
+'''
+
+MACHINE_UNDECLARED_TRANSITION = '''
+from . import constants as C
+def _fail_resume(self, nb):
+    self._patch_annotations(nb, {C.TPU_SUSPEND_STATE_ANNOTATION: "suspended"})
+'''
+
+# the culler's real contract: the checkpointing stamp rides the SAME patch
+# as the stop annotation — both its declared transitions, nothing else
+MACHINE_CLEAN_CULLER = '''
+from . import constants as C
+from ..apimachinery import now_rfc3339
+class R:
+    def reconcile(self, req):
+        updates = {}
+        updates[C.STOP_ANNOTATION] = now_rfc3339()
+        updates[C.TPU_SUSPEND_STATE_ANNOTATION] = "checkpointing"
+        self._patch_annotations(nb, updates)
+'''
+
+
+def test_machine_conformance_flags_non_owning_writer():
+    findings = run_on_source(
+        MACHINE_ROGUE_WRITER, [MachineConformanceChecker()],
+        path="odh_kubeflow_tpu/controllers/rogue.py",
+    )
+    assert any("not a declared writer" in f.message for f in findings)
+    assert all(f.check == "machine-conformance" for f in findings)
+
+
+def test_machine_conformance_flags_undeclared_state():
+    findings = run_on_source(
+        MACHINE_UNDECLARED_STATE, [MachineConformanceChecker()],
+        path="odh_kubeflow_tpu/controllers/suspend.py",
+    )
+    assert any("undeclared state 'warming-up'" in f.message for f in findings)
+
+
+def test_machine_conformance_flags_drifted_transition():
+    # a write the spec knows nothing about: suspended out of _fail_resume
+    findings = run_on_source(
+        MACHINE_UNDECLARED_TRANSITION, [MachineConformanceChecker()],
+        path="odh_kubeflow_tpu/controllers/suspend.py",
+    )
+    assert any(
+        "is not declared" in f.message and "_fail_resume" in f.message
+        for f in findings
+    )
+
+
+def test_machine_conformance_passes_clean_culler_twin():
+    assert run_on_source(
+        MACHINE_CLEAN_CULLER, [MachineConformanceChecker()],
+        path="odh_kubeflow_tpu/controllers/culling.py",
+    ) == []
+
+
+def test_machine_conformance_reports_spec_drift_against_real_modules(tmp_path):
+    # an owner module that no longer implements a declared transition:
+    # scanning it (by its real basename) must surface the other drift
+    # direction — the spec says _begin_resume writes resuming, nobody does
+    mod = tmp_path / "suspend.py"
+    mod.write_text(MACHINE_UNDECLARED_TRANSITION)
+    findings = run_analysis([str(mod)], checkers=[MachineConformanceChecker()])
+    assert any(
+        "declared transition" in f.message
+        and "_begin_resume has no matching write" in f.message
+        for f in findings
+    )
+
+
+def test_repair_owned_conditions_drift_both_directions(tmp_path):
+    conditions = tmp_path / "conditions.py"
+    conditions.write_text(
+        "from . import constants as C\n"
+        "REPAIR_OWNED_CONDITIONS = (\n"
+        "    C.TPU_DEGRADED_CONDITION,\n"
+        "    C.SLO_DEGRADED_CONDITION,\n"
+        ")\n"
+    )
+    repair = tmp_path / "slice_repair.py"
+    repair.write_text(
+        "from . import constants as C\n"
+        "def _enter(self, nb):\n"
+        "    write_condition(c, r, nb, C.TPU_HEALTHY_CONDITION, 'True')\n"
+        "    write_condition(c, r, nb, C.TPU_DEGRADED_CONDITION, 'True')\n"
+    )
+    findings = run_analysis(
+        [str(conditions), str(repair)], checkers=[MachineConformanceChecker()]
+    )
+    messages = " | ".join(f.message for f in findings)
+    # written but not preserved: the mirror will stomp it
+    assert "TPU_HEALTHY_CONDITION is written" in messages
+    # preserved but never written: a dead entry
+    assert "SLO_DEGRADED_CONDITION is never passed" in messages
+
+
+def test_real_tree_conditions_and_machines_are_in_sync():
+    # the package-level pass runs the full drift checks against the real
+    # controllers (owners + conditions.py all in the scan set) — part of
+    # the zero-findings gate, asserted here with the checker isolated so a
+    # failure names the drift rather than a wall of unrelated findings
+    import pathlib
+
+    import odh_kubeflow_tpu
+
+    pkg = pathlib.Path(odh_kubeflow_tpu.__file__).parent
+    findings = run_analysis([str(pkg)], checkers=[MachineConformanceChecker()])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# dead annotation constants (annotation-convention finish pass)
+# ---------------------------------------------------------------------------
+
+
+def test_dead_annotation_constant_flagged(tmp_path):
+    pkg = tmp_path / "controllers"
+    pkg.mkdir()
+    (pkg / "constants.py").write_text(
+        'LIVE_ANNOTATION = "notebooks.tpu.kubeflow.org/live"\n'
+        'DEAD_ANNOTATION = "notebooks.tpu.kubeflow.org/dead"\n'
+    )
+    (pkg / "reader.py").write_text(
+        "from . import constants as C\n"
+        "def f(nb):\n"
+        "    return nb.metadata.annotations.get(C.LIVE_ANNOTATION)\n"
+    )
+    findings = run_analysis(
+        [str(pkg)], checkers=[AnnotationConventionChecker()]
+    )
+    assert len(findings) == 1
+    assert "dead annotation constant DEAD_ANNOTATION" in findings[0].message
+
+
+def test_dead_annotation_constant_passes_when_read(tmp_path):
+    pkg = tmp_path / "controllers"
+    pkg.mkdir()
+    (pkg / "constants.py").write_text(
+        'LIVE_ANNOTATION = "notebooks.tpu.kubeflow.org/live"\n'
+    )
+    (pkg / "reader.py").write_text(
+        "from . import constants as C\n"
+        "def f(nb):\n"
+        "    return nb.metadata.annotations.get(C.LIVE_ANNOTATION)\n"
+    )
+    assert run_analysis(
+        [str(pkg)], checkers=[AnnotationConventionChecker()]
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# pragma budget gate (ci/analysis.sh + ci/pragma_allowlist.txt)
+# ---------------------------------------------------------------------------
+
+
+def test_pragma_budget_collection_and_gate(tmp_path):
+    mod = tmp_path / "m.py"
+    mod.write_text(
+        "x = 1  # lint: disable=lock-discipline\n"
+        "y = 2  # lint: disable=lock-discipline\n"
+        "z = 3  # lint: disable=cache-mutation\n"
+    )
+    budget = collect_pragmas([str(mod)])
+    assert budget == {
+        (str(mod), "lock-discipline"): 2,
+        (str(mod), "cache-mutation"): 1,
+    }
+    allowlist = parse_pragma_allowlist(render_pragma_allowlist(budget))
+    assert allowlist == budget
+    assert pragma_budget_violations(budget, allowlist) == []
+    # one new unreviewed pragma of an ALREADY-allowlisted check still fails
+    mod.write_text(mod.read_text() + "w = 4  # lint: disable=cache-mutation\n")
+    grown = collect_pragmas([str(mod)])
+    problems = pragma_budget_violations(grown, allowlist)
+    assert len(problems) == 1 and "cache-mutation" in problems[0]
+    # shrinkage passes (stale allowlist is nagged elsewhere, not fatal)
+    assert pragma_budget_violations({}, allowlist) == []
+
+
+def test_committed_pragma_allowlist_matches_the_tree():
+    import pathlib
+
+    import odh_kubeflow_tpu
+
+    pkg = pathlib.Path(odh_kubeflow_tpu.__file__).parent
+    repo = pkg.parent
+    allowlist = parse_pragma_allowlist(
+        (repo / "ci" / "pragma_allowlist.txt").read_text()
+    )
+    budget = collect_pragmas([str(pkg)])
+    # paths in the allowlist are repo-relative; collection from an absolute
+    # path yields absolute — normalize to relative-to-repo for comparison
+    normalized = {
+        (str(pathlib.Path(path).resolve().relative_to(repo.resolve())), check): n
+        for (path, check), n in budget.items()
+    }
+    assert pragma_budget_violations(normalized, allowlist) == [], (
+        "unreviewed `# lint: disable` pragmas — regenerate "
+        "ci/pragma_allowlist.txt after review"
+    )
 
 
 # ---------------------------------------------------------------------------

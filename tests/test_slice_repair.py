@@ -124,6 +124,11 @@ def node_pool(cluster, node_name):
 
 def test_host_preemption_checkpoints_and_reschedules_to_ready(env):
     cluster, mgr, agents, repair = env
+    # this test exercises the NODE-signal path (taint -> HostPreempted);
+    # park the probe-absence dwell so a slow post-repair agent under full-
+    # suite CPU load cannot open a spurious second HostUnreachable episode
+    # whose slice.repair span would shadow the one under test
+    repair.unreachable_dwell_s = 30.0
     interruptions0 = telemetry.slice_interruptions_total.value(cause="HostPreempted")
     repairs0 = telemetry.slice_repairs_total.value(result="repaired")
 
