@@ -561,6 +561,126 @@ def _decode_point(cfg, batch, prompt_len, max_new, short_new, max_seq):
     }
 
 
+def bench_serving():
+    """Continuous batching vs the static-batch generate() baseline at EQUAL
+    batch slots under a mixed-length request stream (ISSUE 9 acceptance:
+    goodput >= 1.5x static). Static batching runs every batch to its
+    longest member — finished sequences keep burning HBM-bound decode steps
+    on slots nobody reads; the serving engine recycles each slot at
+    EOS/max-tokens and backfills from the queue, so the same chip does
+    strictly more useful tokens per second. TTFT and per-token latency come
+    from the live engine (p50/p99 over the episode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import TransformerConfig, generate, init_params
+    from odh_kubeflow_tpu.serving.engine import ServingEngine
+
+    cfg = TransformerConfig(
+        vocab=32768,
+        d_model=1024,
+        n_layers=8,
+        n_heads=8,
+        d_ff=4096,
+        max_seq=2048,
+        dtype=jnp.bfloat16,
+        use_flash=True,
+        remat=False,
+    )
+    slots, prompt_len, max_seq = 8, 128, 512
+    # mixed-length stream: a short-heavy mix (the realistic chat shape) with
+    # a long tail — exactly where static batching pays the padding tax
+    lengths = [16, 16, 32, 32, 48, 64, 96, 128, 192, 256] * 2
+    import random as _random
+
+    order = list(lengths)
+    _random.Random(0).shuffle(order)  # arrival order, seeded
+
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    rng = jax.random.PRNGKey(1)
+    prompts = jax.device_get(
+        jax.random.randint(rng, (len(order), prompt_len), 0, cfg.vocab)
+    )
+
+    def fetch(x):
+        int(jnp.sum(x))  # host fetch = true completion
+
+    # -- static baseline: FIFO batches of `slots`, each run to its longest
+    # member (the bench_decode shape, at the same slot count) --
+    batches = [
+        list(range(i, min(i + slots, len(order))))
+        for i in range(0, len(order), slots)
+    ]
+    # compile warm: one throwaway generate per distinct batch length
+    for batch in batches:
+        n = max(order[i] for i in batch)
+        fetch(generate(params, jnp.asarray(prompts[batch], jnp.int32), cfg,
+                       max_new=n, max_seq=max_seq))
+    t0 = time.perf_counter()
+    for batch in batches:
+        n = max(order[i] for i in batch)
+        fetch(generate(params, jnp.asarray(prompts[batch], jnp.int32), cfg,
+                       max_new=n, max_seq=max_seq))
+    static_s = time.perf_counter() - t0
+    useful_tokens = sum(order)
+    static_goodput = useful_tokens / static_s
+
+    # -- continuous batching: same requests, same slot count --
+    engine = ServingEngine(params, cfg, max_slots=slots, max_seq=max_seq,
+                           max_queue_depth=len(order) + 1, decode_burst=16)
+    # compile warm: prefill + one decode step
+    warm = engine.submit(list(prompts[0][:prompt_len]), max_new=2)
+    while not engine.idle():
+        engine.step()
+    assert warm.result == "ok"
+
+    handles = []
+    step_samples = []  # (wall_s, active_slots) per decode step
+    t0 = time.perf_counter()
+    for i, n in enumerate(order):
+        handles.append(engine.submit(list(prompts[i]), max_new=n))
+    while not engine.idle():
+        s0 = time.perf_counter()
+        active = engine.stats()["active_slots"]
+        engine.step()
+        if active:
+            step_samples.append((time.perf_counter() - s0, active))
+    cb_s = time.perf_counter() - t0
+    cb_goodput = sum(len(h.tokens) for h in handles) / cb_s
+
+    def pct(xs, p):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p * (len(xs) - 1) + 0.5))]
+
+    ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+    per_token = [dt for dt, _ in step_samples]
+    return {
+        "continuous_goodput_tokens_per_s": round(cb_goodput),
+        "static_batch_goodput_tokens_per_s": round(static_goodput),
+        # THE acceptance ratio: >= 1.5x at equal batch slots
+        "goodput_vs_static_batch": round(cb_goodput / static_goodput, 3),
+        "ttft_p50_ms": round(pct(ttfts, 0.50) * 1e3, 2) if ttfts else None,
+        "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 2) if ttfts else None,
+        "per_token_p50_ms": (
+            round(pct(per_token, 0.50) * 1e3, 2) if per_token else None
+        ),
+        "per_token_p99_ms": (
+            round(pct(per_token, 0.99) * 1e3, 2) if per_token else None
+        ),
+        "requests": len(order),
+        "batch_slots": slots,
+        "prompt_len": prompt_len,
+        "max_seq": max_seq,
+        "output_lengths": "16-256 mixed (short-heavy, seeded shuffle)",
+        "mean_slot_occupancy": round(
+            sum(a for _, a in step_samples) / (len(step_samples) or 1) / slots,
+            3,
+        ),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Control-plane half (the round-1 benchmark, reported on its own terms)
 # ---------------------------------------------------------------------------
@@ -1186,6 +1306,7 @@ def main() -> None:
         train = run_section("train_step", bench_train_step)
         run_section("decode", bench_decode)
         run_section("moe_train_step", bench_moe_train_step, optional=True)
+        run_section("serving", bench_serving, optional=True)
         run_section("decode_long_cache", bench_decode_long_cache, optional=True)
         run_section("attention_memory", bench_attention_memory, optional=True)
         run_section("flash_block_overhead", bench_flash_block_overhead,

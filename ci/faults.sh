@@ -80,4 +80,23 @@ echo "=== pool churn lane: INVCHECK=1 iteration ==="
 INVCHECK=1 python -m pytest tests/test_suspend.py -q -m "suspend and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, incl. slice chaos + pool churn) ==="
+# serving lane (ISSUE 9): the InferenceEndpoint machine under faults — the
+# serving slice preempted mid-stream (requests drain or fail fast, the
+# endpoint machine owns recovery and the repair controller never fights it),
+# promotion warm-binds, drain/terminate, restore-verification mismatch as an
+# explicit LoadFailed — rerun under the stress loop + one RACECHECK=1 and
+# one INVCHECK=1 iteration (the inference machine is INVCHECK-covered via
+# analysis/machines.py, kind-aware)
+for i in $(seq 1 "$REPEAT"); do
+    echo "=== serving lane: iteration $i/$REPEAT ==="
+    python -m pytest tests/test_serving.py -q -m "serving and not slow" \
+        -p no:cacheprovider -p no:randomly "$@"
+done
+echo "=== serving lane: RACECHECK=1 iteration ==="
+RACECHECK=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+echo "=== serving lane: INVCHECK=1 iteration ==="
+INVCHECK=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, incl. slice chaos + pool churn + serving) ==="

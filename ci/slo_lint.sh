@@ -11,6 +11,12 @@
 # slice_pool_{size,hit_ratio} gauges (cluster/slicepool.py) register into
 # the same live registry the lint checks, so a renamed pool series or an
 # off-bucket resume threshold fails here, not in a dashboard.
+#
+# Since ISSUE 9 it covers the serving layer too: the `token-latency` SLO's
+# inference_token_latency_seconds histogram (threshold must sit on a real
+# bucket) and the `serving-availability` ratio over
+# inference_requests_total{result} (serving/metrics.py — jax-free precisely
+# so this lint sees the families on the manager image).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -58,6 +58,10 @@ class MachineSpec:
     states: Tuple[State, ...]
     transitions: Tuple[Transition, ...]
     doc: str = ""
+    # API kind the annotation lives on — the INVCHECK write monitor judges a
+    # machine only against writes of its own kind (the inference machine's
+    # states on an InferenceEndpoint, never on a Notebook)
+    kind: str = "Notebook"
     # annotation VALUE -> state name, for values that are not state names
     # themselves (the webhook's reconciliation-lock sentinel)
     value_states: Dict[str, str] = field(default_factory=dict)
@@ -240,6 +244,72 @@ CULLING_MACHINE = MachineSpec(
 )
 
 # ---------------------------------------------------------------------------
+# inference endpoint promotion/serving (controllers/inference.py, ISSUE 9)
+# ---------------------------------------------------------------------------
+
+INFERENCE_MACHINE = MachineSpec(
+    name="inference",
+    annotation="INFERENCE_STATE_ANNOTATION",
+    owner="inference.py",
+    kind="InferenceEndpoint",
+    doc="Notebook->serving promotion: a Pending endpoint warm-binds its "
+        "source notebook's released slice, Loading restores+verifies the "
+        "checkpoint, Serving holds the route, and a stop drains bounded "
+        "before the slice is released back warm.",
+    states=(
+        State("", "Pending",
+              "STS/services converging; pods scheduling (warm claim bound "
+              "at promotion when the source notebook just suspended)"),
+        State("loading", "Loading",
+              "all hosts ready; checkpoint restore driven and verified "
+              "(checksum parity with the saved state) inside a bounded "
+              "window"),
+        State("serving", "Serving",
+              "restore verified, mesh ready; HTTPRoute live, engine "
+              "accepting traffic"),
+        State("draining", "Draining",
+              "stop requested: route torn down first, in-flight requests "
+              "drain inside a bounded window; never a reclaim victim"),
+        State("terminated", "Terminated",
+              "drained; replicas 0, slice released (warm unless "
+              "reclaim-forced)",
+              terminal=True, self_healing=True),
+        State("load-failed", "LoadFailed",
+              "loading window expired or restore checksum mismatched",
+              terminal=True, self_healing=True, incident=True),
+    ),
+    transitions=(
+        Transition("", "loading", "inference.py:_run_pending",
+                   "every host Ready: open the restore/verify window"),
+        Transition("serving", "loading", "inference.py:_run_serving",
+                   "host readiness lost while Serving (preemption/crash): "
+                   "re-form and re-verify — the repair machine never touches "
+                   "endpoints, so this edge is the recovery story"),
+        Transition("loading", "serving", "inference.py:_complete_loading",
+                   "restore verified and the mesh gate green"),
+        Transition("loading", "load-failed", "inference.py:_fail_loading",
+                   "window expired or checksum mismatch"),
+        Transition("load-failed", "", "inference.py:reconcile",
+                   "self-heal: pods ready again (or spec changed) — retry "
+                   "the load"),
+        Transition("", "draining", "inference.py:reconcile",
+                   "stopped before serving: drain whatever started"),
+        Transition("loading", "draining", "inference.py:reconcile",
+                   "stopped mid-load"),
+        Transition("serving", "draining", "inference.py:reconcile",
+                   "stop/reclaim: route torn down, drain window opens"),
+        Transition("load-failed", "draining", "inference.py:reconcile",
+                   "stopped while LoadFailed: wind down cleanly"),
+        Transition("draining", "terminated", "inference.py:_complete_drain",
+                   "drained (or deadline): replicas 0, slice released"),
+        Transition("terminated", "", "inference.py:reconcile",
+                   "unstop: serve again (a fresh Pending episode)"),
+        Transition("*", "", "inference.py:reconcile",
+                   "defensive clear of an unknown state value"),
+    ),
+)
+
+# ---------------------------------------------------------------------------
 # warm-pool node machine (cluster/slicepool.py) — NOT statically checked
 # (its annotations live on Nodes and their canonical home is slicepool.py);
 # declared here so the INVCHECK monitor and the explorer validate observed
@@ -250,6 +320,7 @@ POOL_MACHINE = MachineSpec(
     name="slice-pool",
     annotation="POOL_STATE_ANNOTATION",
     owner="slicepool.py",
+    kind="Node",
     doc="Node-durable warm pool: release holds a suspended slice warm; "
         "claims CAS through the lead node's resourceVersion.",
     states=(
@@ -279,9 +350,11 @@ POOL_MACHINE = MachineSpec(
     ),
 )
 
-# the three statically-checked machines (the ISSUE 8 contract) + the pool
+# the statically-checked machines (ISSUE 8 contract + ISSUE 9's inference
+# machine, covered by the conformance checker and explorer from day one) +
+# the runtime-only pool machine
 MACHINES: Tuple[MachineSpec, ...] = (
-    SUSPEND_MACHINE, REPAIR_MACHINE, CULLING_MACHINE,
+    SUSPEND_MACHINE, REPAIR_MACHINE, CULLING_MACHINE, INFERENCE_MACHINE,
 )
 ALL_MACHINES: Tuple[MachineSpec, ...] = MACHINES + (POOL_MACHINE,)
 

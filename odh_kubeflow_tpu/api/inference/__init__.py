@@ -1,0 +1,10 @@
+from .v1beta1 import (
+    API_VERSION,
+    GROUP,
+    KIND,
+    InferenceEndpoint,
+    InferenceEndpointSpec,
+    InferenceEndpointStatus,
+    NotebookRef,
+    ServingSpec,
+)

@@ -1,0 +1,85 @@
+"""InferenceEndpoint CRD, v1beta1 (ISSUE 9).
+
+The second workload class: a long-lived serving deployment promoted from an
+interactive notebook (or pointed straight at a checkpoint path). The spec
+deliberately mirrors the Notebook CR's shape — the same ``spec.tpu`` block
+drives slice planning, the same pod-template escape hatch exists — so the
+reconciler reuses the STS/headless-service/HTTPRoute/scheduler/slicepool
+machinery rather than growing a parallel stack.
+
+Promotion contract: with ``spec.notebookRef`` set, the endpoint inherits the
+source notebook's slice shape (when ``spec.tpu`` is empty) and its saved
+checkpoint lineage (step + checksum annotations), and — when the notebook
+just suspended — claims its warm slice from the pool, so promotion is a warm
+bind, not a cold create.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ...apimachinery import Condition, KubeModel, KubeObject, default_scheme
+from ..notebook.v1beta1 import NotebookTemplateSpec, TPUSpec, TPUStatus
+
+GROUP = "kubeflow.org"
+API_VERSION = "kubeflow.org/v1beta1"
+KIND = "InferenceEndpoint"
+
+
+@dataclass
+class NotebookRef(KubeModel):
+    """Source notebook of a promotion; empty = serve straight from
+    ``spec.serving.checkpointPath`` with no lineage."""
+
+    name: str = ""
+    namespace: str = ""  # "" -> the endpoint's own namespace
+
+
+@dataclass
+class ServingSpec(KubeModel):
+    """Continuous-batching engine shape (serving/engine.py): KV-cache slots,
+    admission-queue bound, and sequence budget per request."""
+
+    max_batch_slots: int = 8  # concurrent sequences (KV-cache slots)
+    max_queue_depth: int = 64  # bounded admission queue; overflow = 429
+    max_seq: int = 2048  # per-slot KV-cache extent
+    max_new_tokens: int = 256  # per-request generation cap
+    # decode steps per dispatch (the prefill/decode scheduling knob):
+    # amortizes the per-dispatch latency floor while bounding admission
+    # delay at this many decode steps
+    decode_burst: int = 8
+    checkpoint_path: str = ""  # orbax dir; promotion fills it from the source
+    # bounded drain: Draining waits this long for in-flight requests before
+    # the gang scales away (0 -> the controller default)
+    drain_timeout_s: float = 0.0
+
+
+@dataclass
+class InferenceEndpointSpec(KubeModel):
+    notebook_ref: Optional[NotebookRef] = None
+    tpu: Optional[TPUSpec] = None  # empty + notebookRef -> inherited
+    serving: ServingSpec = field(default_factory=ServingSpec)
+    # pod template override (the serving image); defaulted like a notebook's
+    template: NotebookTemplateSpec = field(default_factory=NotebookTemplateSpec)
+
+
+@dataclass
+class InferenceEndpointStatus(KubeModel):
+    conditions: List[Condition] = field(default_factory=list)
+    ready_replicas: int = 0
+    # human mirror of the annotation-durable machine (the annotation is the
+    # durable truth; this is for kubectl get)
+    phase: str = ""
+    tpu: Optional[TPUStatus] = None
+    url: str = ""  # route path once Serving
+
+
+@dataclass
+class InferenceEndpoint(KubeObject):
+    spec: InferenceEndpointSpec = field(default_factory=InferenceEndpointSpec)
+    status: InferenceEndpointStatus = field(
+        default_factory=InferenceEndpointStatus
+    )
+
+
+default_scheme.register(API_VERSION, KIND, InferenceEndpoint)

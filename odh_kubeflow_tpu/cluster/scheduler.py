@@ -241,12 +241,20 @@ class Scheduler:
 
     @staticmethod
     def _pod_owner(pod: Pod) -> str:
-        """ns/notebook of a notebook pod — what a claimed pool's
-        `pool-claimed-by` must equal for the bind to be allowed."""
-        from ..controllers.constants import NOTEBOOK_NAME_LABEL
+        """ns/name of the workload that owns this pod — what a claimed
+        pool's `pool-claimed-by` must equal for the bind to be allowed.
+        Notebooks and InferenceEndpoints share the claim namespace: a
+        promoted endpoint claims its source notebook's released slice under
+        its OWN key, and only its pods may land there (ISSUE 9)."""
+        from ..controllers.constants import (
+            INFERENCE_NAME_LABEL,
+            NOTEBOOK_NAME_LABEL,
+        )
 
-        nb = pod.metadata.labels.get(NOTEBOOK_NAME_LABEL, "")
-        return f"{pod.metadata.namespace}/{nb}" if nb else ""
+        owner = pod.metadata.labels.get(
+            NOTEBOOK_NAME_LABEL, ""
+        ) or pod.metadata.labels.get(INFERENCE_NAME_LABEL, "")
+        return f"{pod.metadata.namespace}/{owner}" if owner else ""
 
     @staticmethod
     def _pool_reservation(pool_nodes: List[Node]) -> Optional[str]:

@@ -92,11 +92,25 @@ notebook_reclaims_total = global_registry.counter(
     "poisoned = an unhealthy slice swept out of the pool)",
     labels=("reason",),
 )
+slice_pool_prewarmed_total = global_registry.counter(
+    "slice_pool_prewarmed_total",
+    "Free slices proactively parked warm by the POOL_PREWARM target "
+    "(spun up, mesh-formed, held ahead of demand) rather than recycled "
+    "from a suspension",
+)
 notebook_resume_seconds = global_registry.histogram(
     "notebook_resume_seconds",
     "Unstop -> mesh-ready-again latency per resumed notebook (the warm-pool "
     "counterpart of the cold-create north-star histogram)",
     buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300),
+)
+notebook_restore_verifications_total = global_registry.counter(
+    "notebook_restore_verifications_total",
+    "Resume-side checkpoint restore verifications by result (ok = the "
+    "/tpu/restore checksum matched the saved one; mismatch = the restored "
+    "kernel differs from what the suspend saved; unverified = no saved "
+    "checksum or no restore hook to ask)",
+    labels=("result",),
 )
 
 
@@ -361,6 +375,57 @@ class SlicePool:
         )
         return victim
 
+    def prewarm(self, gke_accelerator: str, topology: str, target: int) -> int:
+        """POOL_PREWARM (ISSUE 9 satellite): keep `target` warm slices of
+        this shape AHEAD of demand — free, healthy, unreserved pools are
+        parked warm (env staged, mesh formed) instead of waiting for a
+        suspension to recycle one. Priority 0: a prewarmed slice is the
+        FIRST idle-reclaim victim under pressure, so prewarming never
+        outranks a real suspended user's warm hold. Returns slices parked."""
+        from ..api.core import Pod
+
+        warm = sum(
+            1 for e in self.entries()
+            if e.state == POOL_STATE_WARM
+            and e.accelerator == gke_accelerator
+            and e.topology == topology
+        )
+        if warm >= target:
+            return 0
+        occupied = {
+            p.spec.node_name
+            for p in self.client.list(Pod)
+            if p.spec.node_name and not p.metadata.deletion_timestamp
+        }
+        by_pool: Dict[str, List[Node]] = {}
+        for node in self.client.list(Node):
+            labels = node.metadata.labels
+            if labels.get(GKE_TPU_ACCELERATOR_LABEL) != gke_accelerator:
+                continue
+            if labels.get(GKE_TPU_TOPOLOGY_LABEL) != topology:
+                continue
+            by_pool.setdefault(
+                labels.get(GKE_NODEPOOL_LABEL, node.metadata.name), []
+            ).append(node)
+        parked = 0
+        for pool, nodes in sorted(by_pool.items()):
+            if warm + parked >= target:
+                break
+            free = all(
+                n.metadata.name not in occupied
+                and not n.metadata.annotations.get(POOL_STATE_ANNOTATION)
+                and self.node_healthy(n)
+                for n in nodes
+            )
+            if not free:
+                continue
+            if self.release(pool, [n.metadata.name for n in nodes], priority=0):
+                slice_pool_prewarmed_total.inc()
+                parked += 1
+                log.info("slice pool: prewarmed %s (%s %s)",
+                         pool, gke_accelerator, topology)
+        return parked
+
     def sweep(self) -> int:
         """Drop pool marks from slices that are no longer honest pool
         members: unhealthy nodes (pool poisoning — a warm entry whose host
@@ -403,3 +468,56 @@ class SlicePool:
         if swept:
             self.refresh_gauges()
         return swept
+
+
+class PoolPrewarmer:
+    """Manager service (start/stop lifecycle) holding the POOL_PREWARM
+    target: every period it sweeps poisoned entries and parks free slices of
+    the configured shape warm until `target` are held. The suspend path's
+    recycling and this proactive path share every pool verb, so the
+    scheduler/claim/reclaim contracts hold identically for both."""
+
+    def __init__(self, client, gke_accelerator: str, topology: str,
+                 target: int, period_s: float = 5.0):
+        import threading
+
+        self.pool = SlicePool(client)
+        self.gke_accelerator = gke_accelerator
+        self.topology = topology
+        self.target = max(0, target)
+        self.period_s = max(0.05, period_s)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def tick(self) -> int:
+        self.pool.sweep()
+        return self.pool.prewarm(
+            self.gke_accelerator, self.topology, self.target
+        )
+
+    def start(self) -> None:
+        import threading
+
+        if self._thread is not None or self.target <= 0:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="pool-prewarmer"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.tick()
+            except Exception:
+                # one bad sweep (apiserver blip mid-scan) must not kill the
+                # prewarmer loop; the next period retries
+                log.exception("pool prewarm tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+        self._thread = None

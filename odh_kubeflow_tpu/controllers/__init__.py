@@ -3,6 +3,7 @@ from .config import Config
 from .metrics import NotebookMetrics
 from .notebook import EventMirrorController, NotebookReconciler, hosts_service_name
 from .culling import CullingReconciler
+from .inference import InferenceEndpointReconciler
 from .probe_status import ProbeStatusController
 from .slice_repair import SliceRepairController
 from .suspend import SuspendResumeController

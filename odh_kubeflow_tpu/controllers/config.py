@@ -77,6 +77,18 @@ class Config:
     # how long a TPU pod must sit unschedulable before the reclaimer acts —
     # the scheduler's capacity-freed fast path gets first shot
     reclaim_pending_grace_s: float = 1.0
+    # slice-pool pre-warming (ISSUE 9 satellite): keep this many warm slices
+    # of the configured shape AHEAD of demand (spin up, mesh-form, park)
+    # instead of only recycling suspended ones. 0 = off.
+    pool_prewarm: int = 0
+    pool_prewarm_accelerator: str = "v5e"
+    pool_prewarm_topology: str = "2x2"
+    # inference serving (controllers/inference.py): how long Loading gets to
+    # reach mesh-ready + verified restore before LoadFailed, and the default
+    # drain window a stopped endpoint's in-flight requests get (overridable
+    # per-endpoint via spec.serving.drainTimeoutS)
+    serving_loading_window_s: float = 30.0
+    serving_drain_timeout_s: float = 5.0
     # SLO engine + alerting (runtime/slo.py, runtime/alerts.py): window_scale
     # shrinks the canonical 5m/30m/1h/6h burn windows (soaks/tests run the
     # real rule shapes in seconds); eval period 0 derives from the scale
@@ -163,6 +175,24 @@ class Config:
         if os.environ.get("RECLAIM_PENDING_GRACE_S"):
             c.reclaim_pending_grace_s = max(
                 0.0, float(os.environ["RECLAIM_PENDING_GRACE_S"])
+            )
+        if os.environ.get("POOL_PREWARM"):
+            c.pool_prewarm = max(0, int(os.environ["POOL_PREWARM"]))
+        c.pool_prewarm_accelerator = os.environ.get(
+            "POOL_PREWARM_ACCELERATOR", c.pool_prewarm_accelerator
+        )
+        c.pool_prewarm_topology = os.environ.get(
+            "POOL_PREWARM_TOPOLOGY", c.pool_prewarm_topology
+        )
+        if os.environ.get("SERVING_LOADING_WINDOW_S"):
+            # clamp: a zero window would declare LoadFailed before the first
+            # readiness probe ever ran
+            c.serving_loading_window_s = max(
+                0.1, float(os.environ["SERVING_LOADING_WINDOW_S"])
+            )
+        if os.environ.get("SERVING_DRAIN_TIMEOUT_S"):
+            c.serving_drain_timeout_s = max(
+                0.0, float(os.environ["SERVING_DRAIN_TIMEOUT_S"])
             )
         c.slo_enabled = _env_bool("SLO_ENABLED", c.slo_enabled)
         if os.environ.get("SLO_WINDOW_SCALE"):

@@ -96,6 +96,38 @@ TPU_RECLAIM_ANNOTATION = "notebooks.tpu.kubeflow.org/reclaimed"
 # the pressure incident
 TPU_RECLAIM_EXEMPT_LABEL = "notebooks.tpu.kubeflow.org/reclaim-exempt"
 
+# -- inference serving (controllers/inference.py) --
+# The promotion state machine, annotation-durable like the suspend/repair
+# machines above (declared as data in analysis/machines.py):
+#   Pending ("") -> Loading (pods ready; checkpoint restore + verification)
+#                -> Serving (verified; route live)  |  LoadFailed (terminal)
+#   Serving/Loading/Pending --stop--> Draining (route torn down; bounded
+#   drain window) -> Terminated (replicas 0; slice released warm)
+INFERENCE_STATE_ANNOTATION = "inference.tpu.kubeflow.org/endpoint-state"
+INFERENCE_LOADING_DEADLINE_ANNOTATION = (
+    "inference.tpu.kubeflow.org/loading-deadline"
+)
+INFERENCE_DRAIN_DEADLINE_ANNOTATION = "inference.tpu.kubeflow.org/drain-deadline"
+# stamped at promotion time with the source notebook's ns/name so the
+# endpoint's warm claim, checkpoint lineage, and trace all name their origin
+INFERENCE_PROMOTED_FROM_ANNOTATION = "inference.tpu.kubeflow.org/promoted-from"
+# pod -> owning InferenceEndpoint (the serving analog of notebook-name: the
+# scheduler's claimed-pool owner check and the sim probe agent both key on it)
+INFERENCE_NAME_LABEL = "inference-endpoint-name"
+# Serving endpoints default ABOVE interactive notebooks in the reclaim
+# ordering (ISSUE 9 bugfix): a spec.tpu.priority of 0 on an endpoint reads
+# as this value, so an idle notebook is always suspended before live traffic
+ENDPOINT_DEFAULT_PRIORITY = 10
+
+# -- checkpoint restore verification (ISSUE 9 satellite) --
+# checksum of the state the checkpoint hook saved (probe agent ack); after
+# resume — and after endpoint Loading — the /tpu/restore probe's checksum is
+# compared against this, so "the restored kernel equals the saved one" is
+# asserted end-to-end instead of assumed
+TPU_CHECKPOINT_CHECKSUM_ANNOTATION = (
+    "notebooks.tpu.kubeflow.org/checkpoint-checksum"
+)
+
 # -- TPU-native additions --
 TPU_SLICE_POOL_LABEL = "notebooks.tpu.kubeflow.org/slice-pool"
 # stamped on Events the mirror controller creates, and checked on ingest, so

@@ -539,6 +539,16 @@ class NotebookReconciler:
         if tpu_patch is not None:
             for k in ("chipsVisible", "meshReady", "firstReadyTime"):
                 tpu_patch.pop(k, None)
+            # zero must be WRITTEN, not omitted: to_dict's omitempty drops
+            # hostsReady=0, so a drained slice's stored non-zero count could
+            # never converge — the no-op pre-check then failed on every
+            # pass and each content-identical patch still bumped
+            # resourceVersion, re-enqueueing this notebook in a ~165/s
+            # write loop for as long as it stayed suspended (found by the
+            # ISSUE 9 promotion drive when the loop's spans flooded the
+            # trace ring)
+            tpu_patch["hostsReady"] = status.tpu.hosts_ready
+        spatch["readyReplicas"] = status.ready_replicas  # same zero contract
         if "containerState" not in spatch:
             spatch["containerState"] = None  # explicit null deletes (pod gone)
         try:

@@ -52,6 +52,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..api.core import Pod, emit_deduped_event
+from ..api.inference import InferenceEndpoint
 from ..api.notebook import Notebook
 from ..apimachinery import (
     NotFoundError,
@@ -63,6 +64,7 @@ from ..cluster.client import retry_on_conflict
 from ..cluster.slicepool import (
     SlicePool,
     notebook_reclaims_total,
+    notebook_restore_verifications_total,
     notebook_resume_seconds,
     record_claim,
 )
@@ -74,6 +76,12 @@ from ..utils.tracing import record_span
 from . import constants as C
 from .config import Config
 from .culling import HTTPGet, _default_http_get
+from .inference import (
+    STATE_DRAINING as EP_STATE_DRAINING,
+    STATE_SERVING as EP_STATE_SERVING,
+    STATE_TERMINATED as EP_STATE_TERMINATED,
+    endpoint_priority,
+)
 from .notebook import per_ordinal_probe_urls
 
 log = logging.getLogger(__name__)
@@ -112,9 +120,11 @@ class SuspendResumeController:
         self.http_get = http_get or _default_http_get
         self.pool = SlicePool(manager.client)
         # in-memory only (the durable machine lives in annotations):
-        # per-episode checkpoint acks (ordinal -> acked step) and resume
-        # attempt deadlines; both re-derivable after a restart
+        # per-episode checkpoint acks (ordinal -> acked step), their state
+        # checksums (ordinal -> digest; the restore-side verification
+        # contract), and resume attempt deadlines; all re-derivable
         self._ckpt_acked: Dict[str, Dict[int, Optional[int]]] = {}
+        self._ckpt_checksums: Dict[str, Dict[int, str]] = {}
         self._resume_deadline: Dict[str, float] = {}
         # requester -> last active-suspend reclaim: a short cooldown bridges
         # the victim-drained -> scheduler-caught-up gap, so one pressure
@@ -306,6 +316,7 @@ class SuspendResumeController:
             except (IndexError, ValueError):
                 continue
         acked = self._ckpt_acked.setdefault(req.key, {})
+        checksums = self._ckpt_checksums.setdefault(req.key, {})
         pending = sorted(ready_ordinals - set(acked))
         if pending and now < deadline:
             for ordinal, ack in self._checkpoint_sweep(
@@ -313,6 +324,8 @@ class SuspendResumeController:
             ):
                 if ack and ack.get("saved"):
                     acked[ordinal] = ack.get("step")
+                    if ack.get("checksum"):
+                        checksums[ordinal] = str(ack["checksum"])
         all_acked = bool(ready_ordinals) and ready_ordinals <= set(acked)
         if not (all_acked or not ready_ordinals or now >= deadline):
             return Result(requeue_after=max(
@@ -327,11 +340,24 @@ class SuspendResumeController:
             C.TPU_SUSPEND_CHECKPOINT_DEADLINE_ANNOTATION: None,
         }
         self._ckpt_acked.pop(req.key, None)
+        checksums = self._ckpt_checksums.pop(req.key, {})
         if acked:
             telemetry.slice_checkpoint_saves_total.inc(len(acked))
             steps = [s for s in acked.values() if s is not None]
             if steps:
                 updates[C.TPU_CHECKPOINT_SAVED_ANNOTATION] = str(max(steps))
+                # ordinal 0's digest ONLY, and only when ordinal 0 acked the
+                # step being recorded: saves are per-shard (each host writes
+                # what it owns), so digests are host-specific — the one
+                # well-defined comparison is ordinal 0's save vs ordinal 0's
+                # restore. Storing another ordinal's digest would
+                # manufacture a guaranteed mismatch on multi-host slices;
+                # no digest means verification reports "unverified", never
+                # a false alarm.
+                if acked.get(0) == max(steps) and 0 in checksums:
+                    updates[C.TPU_CHECKPOINT_CHECKSUM_ANNOTATION] = (
+                        checksums[0]
+                    )
         reclaimed = ann.get(C.TPU_RECLAIM_ANNOTATION, "")
         pool_name = self._slice_pool_of(pods)
         released = False
@@ -531,6 +557,7 @@ class SuspendResumeController:
         except ValueError:
             pass
         latency = max(0.0, now - started)
+        self._verify_restore(nb, req)
         # the bind window is over: the slice is plainly owned by its pods —
         # pool marks off, so a later suspend re-releases it cleanly
         self._release_claims(req.key, back_to_warm=False, nb=nb)
@@ -566,6 +593,41 @@ class SuspendResumeController:
         self._forget(req.key)
         log.info("resumed %s in %.2fs", req.key, latency)
         return None
+
+    def _verify_restore(self, nb: Notebook, req: Request) -> None:
+        """Restore-side verification (ISSUE 9 satellite): the resumed
+        kernel must equal the saved one. Ordinal 0's /tpu/restore ack is
+        compared against the checksum the suspend-side checkpoint recorded;
+        a mismatch is surfaced loudly (Warning event + counter) but never
+        blocks the resume — a live-but-suspect notebook beats a wedged one,
+        and the operator sees exactly which state diverged."""
+        from .inference import classify_restore, probe_restore_ack
+
+        ann = nb.metadata.annotations
+        expected = ann.get(C.TPU_CHECKPOINT_CHECKSUM_ANNOTATION, "")
+        if not expected:
+            return  # nothing was acked with a digest: nothing to verify
+        shape = plan_slice(
+            nb.spec.tpu.accelerator, nb.spec.tpu.topology, nb.spec.tpu.chips
+        )
+        urls = per_ordinal_probe_urls(
+            self.client, self.config, nb, shape.hosts, "/tpu/restore"
+        )
+        ack = probe_restore_ack(self.http_get, urls[0]) if urls else None
+        verdict, detail = classify_restore(ack, expected)
+        notebook_restore_verifications_total.inc(result=verdict)
+        if verdict == "ok":
+            self._emit_event(
+                nb, "RestoreVerified",
+                f"restored kernel verified: {detail}", etype="Normal",
+            )
+        elif verdict == "mismatch":
+            self._emit_event(
+                nb, "RestoreVerifyFailed",
+                f"restored kernel does NOT equal the saved one: {detail}",
+            )
+            log.error("restore verification MISMATCH for %s: %s",
+                      req.key, detail)
 
     def _fail_resume(self, nb: Notebook, now: float, req: Request) -> None:
         self._patch_annotations(
@@ -640,11 +702,37 @@ class SuspendResumeController:
             return Result(requeue_after=0.2)
 
         # one victim at a time: a reclaim-forced suspend takes a checkpoint
-        # window to free its slice, and the requester's pods stay pending the
-        # whole while — without this guard every reclaim pass in that window
-        # would pick a FRESH victim and cascade suspensions for one slice
-        # (the durable reclaim annotation is the in-flight marker, so the
-        # guard survives controller restarts)
+        # window (and a reclaim-forced endpoint drain takes its drain
+        # window) to free its slice, and the requester's pods stay pending
+        # the whole while — without this guard every reclaim pass in that
+        # window would pick a FRESH victim and cascade for one slice (the
+        # durable reclaim annotation is the in-flight marker, so the guard
+        # survives controller restarts)
+        for ep in self.client.list(InferenceEndpoint):
+            if (
+                ep.metadata.annotations.get(C.TPU_RECLAIM_ANNOTATION)
+                != f"capacity-pressure:{req.key}"
+            ):
+                continue
+            estate = ep.metadata.annotations.get(
+                C.INFERENCE_STATE_ANNOTATION
+            )
+            still_draining = estate == EP_STATE_DRAINING or (
+                estate == EP_STATE_TERMINATED
+                and any(
+                    True
+                    for p in self.client.list(
+                        Pod,
+                        namespace=ep.metadata.namespace,
+                        labels={
+                            C.INFERENCE_NAME_LABEL: ep.metadata.name
+                        },
+                    )
+                    if not p.metadata.deletion_timestamp
+                )
+            )
+            if still_draining:
+                return Result(requeue_after=0.2)
         for cand in self.client.list(Notebook):
             if (
                 cand.metadata.annotations.get(C.TPU_RECLAIM_ANNOTATION)
@@ -708,11 +796,61 @@ class SuspendResumeController:
             )
             return Result(requeue_after=0.05)
 
-        # 2) suspend the lowest-priority eligible running notebook
+        # 2) suspend (or drain) the lowest-priority eligible running
+        #    workload — notebooks and Serving endpoints compete in ONE
+        #    priority order (ISSUE 9 bugfix: endpoints default above
+        #    interactive, and a Draining endpoint is never re-victimized)
         cooldown = max(1.0, self.config.suspend_checkpoint_window_s * 0.5)
         if now - self._victim_cooldown.get(req.key, 0.0) < cooldown:
             return Result(requeue_after=0.2)
         victim = self._pick_suspend_victim(nb, shape)
+        ep_victim = self._pick_endpoint_victim(nb, shape)
+        if victim is not None and ep_victim is not None:
+            # strictly-lower priority loses; notebooks break ties (an
+            # endpoint only drains when it is UNAMBIGUOUSLY the cheapest)
+            if endpoint_priority(ep_victim) < notebook_priority(victim):
+                victim = None
+            else:
+                ep_victim = None
+        if ep_victim is not None:
+            self._victim_cooldown[req.key] = now
+            ekey = f"{ep_victim.metadata.namespace}/{ep_victim.metadata.name}"
+            self._patch_endpoint_victim(
+                ep_victim,
+                {
+                    C.STOP_ANNOTATION: now_rfc3339(),
+                    C.TPU_RECLAIM_ANNOTATION: f"capacity-pressure:{req.key}",
+                },
+            )
+            notebook_reclaims_total.inc(reason="endpoint-drain")
+            self._emit_event(
+                nb, "SliceReclaimed",
+                f"draining serving endpoint {ekey} (priority "
+                f"{endpoint_priority(ep_victim)}) to free capacity for "
+                f"{req.key} (priority {notebook_priority(nb)}); in-flight "
+                "requests drain bounded before the slice moves",
+                etype="Normal",
+            )
+            recorder.record(
+                "transition", machine="suspend", notebook=req.key,
+                state="reclaim", victim=ekey, reason="endpoint-drain",
+            )
+            recorder.snapshot(
+                "reclaim", subject=ekey, client=self.client,
+                notebooks=[(nb.metadata.namespace, nb.metadata.name)],
+                extra={
+                    "reason": "endpoint-drain",
+                    "requester": req.key,
+                    "requester_priority": notebook_priority(nb),
+                    "victim_priority": endpoint_priority(ep_victim),
+                },
+            )
+            log.warning(
+                "reclaim: draining endpoint %s (priority %d) for %s "
+                "(priority %d)", ekey, endpoint_priority(ep_victim),
+                req.key, notebook_priority(nb),
+            )
+            return Result(requeue_after=0.1)
         if victim is None:
             return Result(requeue_after=max(1.0, grace))
         self._victim_cooldown[req.key] = now
@@ -807,6 +945,71 @@ class SuspendResumeController:
         candidates.sort(key=lambda t: (t[0], t[1], t[2]))
         return candidates[0][3]
 
+    def _pick_endpoint_victim(
+        self, requester: Notebook, shape
+    ) -> Optional[InferenceEndpoint]:
+        """Serving endpoints are reclaim victims by `spec.tpu.priority`
+        exactly like notebooks — but they default ABOVE interactive
+        (ENDPOINT_DEFAULT_PRIORITY), only a Serving endpoint is eligible
+        (its slice is confirmed live capacity), and a Draining endpoint is
+        NEVER re-victimized mid-drain (ISSUE 9 bugfix): its slice is
+        already on the way out, a second stamp would only reset the drain
+        window it is racing to finish."""
+        from .inference import resolve_endpoint_tpu
+
+        my_priority = notebook_priority(requester)
+        candidates: List[Tuple[int, str, InferenceEndpoint]] = []
+        for cand in self.client.list(InferenceEndpoint):
+            if cand.metadata.deletion_timestamp:
+                continue
+            ann = cand.metadata.annotations
+            state = ann.get(C.INFERENCE_STATE_ANNOTATION, "")
+            if state != EP_STATE_SERVING:
+                continue  # Draining/Terminated/Loading free nothing usable
+            if C.STOP_ANNOTATION in ann:
+                continue  # already winding down
+            if cand.metadata.labels.get(C.TPU_RECLAIM_EXEMPT_LABEL):
+                continue
+            tpu = resolve_endpoint_tpu(self.client, cand)
+            if tpu is None:
+                continue
+            try:
+                cshape = plan_slice(tpu.accelerator, tpu.topology, tpu.chips)
+            except Exception as e:
+                log.debug("victim scan: unplannable endpoint %s/%s: %s",
+                          cand.metadata.namespace, cand.metadata.name, e)
+                continue
+            if (
+                cshape.gke_accelerator != shape.gke_accelerator
+                or cshape.topology != shape.topology
+            ):
+                continue
+            pri = endpoint_priority(cand)
+            if pri >= my_priority:
+                continue
+            key = f"{cand.metadata.namespace}/{cand.metadata.name}"
+            candidates.append((pri, key, cand))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda t: (t[0], t[1]))
+        return candidates[0][2]
+
+    def _patch_endpoint_victim(
+        self, victim: InferenceEndpoint, updates: dict
+    ) -> None:
+        def attempt():
+            return self.client.patch(
+                InferenceEndpoint,
+                victim.metadata.namespace,
+                victim.metadata.name,
+                {"metadata": {"annotations": updates}},
+            )
+
+        try:
+            retry_on_conflict(attempt)
+        except NotFoundError:
+            pass  # deleted mid-reclaim; pressure re-judges next pass
+
     def _matching_capacity_free(self, shape) -> bool:
         """True when a whole healthy, unreserved pool of the requester's
         shape has no TPU pods on it — a gang-placeable slice the scheduler
@@ -866,6 +1069,32 @@ class SuspendResumeController:
                 log.debug(
                     "budget math: skipping unplannable %s/%s: %s",
                     cand.metadata.namespace, cand.metadata.name, e,
+                )
+                continue
+        # the second workload class holds budget too: an admitted endpoint
+        # is chip demand exactly like a notebook (Terminated ones released
+        # their slice and dropped out of the demand picture)
+        from .inference import resolve_endpoint_tpu
+
+        for ep in self.client.list(InferenceEndpoint):
+            if ep.metadata.deletion_timestamp:
+                continue
+            if (
+                ep.metadata.annotations.get(C.INFERENCE_STATE_ANNOTATION)
+                == EP_STATE_TERMINATED
+            ):
+                continue
+            tpu = resolve_endpoint_tpu(self.client, ep)
+            if tpu is None:
+                continue
+            try:
+                total += plan_slice(
+                    tpu.accelerator, tpu.topology, tpu.chips
+                ).chips
+            except Exception as e:
+                log.debug(
+                    "budget math: skipping unplannable endpoint %s/%s: %s",
+                    ep.metadata.namespace, ep.metadata.name, e,
                 )
                 continue
         return total
@@ -935,6 +1164,7 @@ class SuspendResumeController:
 
     def _forget(self, key: str) -> None:
         self._ckpt_acked.pop(key, None)
+        self._ckpt_checksums.pop(key, None)
         self._resume_deadline.pop(key, None)
         self._victim_cooldown.pop(key, None)
 

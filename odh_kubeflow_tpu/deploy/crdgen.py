@@ -138,3 +138,69 @@ def notebook_crd(served_versions=None) -> Dict[str, Any]:
             "versions": versions,
         },
     }
+
+
+def inference_endpoint_crd() -> Dict[str, Any]:
+    """The InferenceEndpoint CustomResourceDefinition (ISSUE 9). One served
+    version: v1beta1 is both hub and storage — the serving surface is new,
+    there are no legacy spokes to convert."""
+    from ..api.inference import InferenceEndpoint
+
+    spec_schema = schema_for_model(
+        typing.get_type_hints(InferenceEndpoint)["spec"]
+    )
+    status_schema = schema_for_model(
+        typing.get_type_hints(InferenceEndpoint)["status"]
+    )
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "inferenceendpoints.kubeflow.org"},
+        "spec": {
+            "group": "kubeflow.org",
+            "names": {
+                "kind": "InferenceEndpoint",
+                "listKind": "InferenceEndpointList",
+                "plural": "inferenceendpoints",
+                "singular": "inferenceendpoint",
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": "v1beta1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": spec_schema,
+                                "status": status_schema,
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Phase",
+                            "type": "string",
+                            "jsonPath": ".status.phase",
+                        },
+                        {
+                            "name": "Ready",
+                            "type": "integer",
+                            "jsonPath": ".status.readyReplicas",
+                        },
+                        {
+                            "name": "URL",
+                            "type": "string",
+                            "jsonPath": ".status.url",
+                        },
+                    ],
+                }
+            ],
+        },
+    }

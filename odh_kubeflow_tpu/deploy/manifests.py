@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from .crdgen import notebook_crd
+from .crdgen import inference_endpoint_crd, notebook_crd
 
 APP_LABELS = {"app.kubernetes.io/part-of": "tpu-notebook-controller"}
 
@@ -58,7 +58,14 @@ def cluster_role() -> Dict[str, Any]:
     rules: List[Dict[str, Any]] = [
         {
             "apiGroups": ["kubeflow.org"],
-            "resources": ["notebooks", "notebooks/status", "notebooks/finalizers"],
+            "resources": [
+                "notebooks",
+                "notebooks/status",
+                "notebooks/finalizers",
+                "inferenceendpoints",
+                "inferenceendpoints/status",
+                "inferenceendpoints/finalizers",
+            ],
             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
         },
         {
@@ -305,6 +312,7 @@ def base_manifests(ns: str, image: str, auth_proxy_image: str) -> List[Dict[str,
     return [
         namespace(ns),
         notebook_crd(),
+        inference_endpoint_crd(),
         service_account(ns),
         cluster_role(),
         cluster_role_binding(ns),

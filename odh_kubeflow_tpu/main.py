@@ -15,6 +15,7 @@ from .controllers import (
     Config,
     CullingReconciler,
     EventMirrorController,
+    InferenceEndpointReconciler,
     NotebookReconciler,
     NotebookWebhook,
     ProbeStatusController,
@@ -59,6 +60,19 @@ def build_manager(
     CullingReconciler(mgr, config, http_get=http_get, metrics=metrics).setup()
     SliceRepairController(mgr, config, http_get=http_get).setup()
     SuspendResumeController(mgr, config, http_get=http_get).setup()
+    InferenceEndpointReconciler(mgr, config, http_get=http_get).setup()
+    if config.pool_prewarm > 0:
+        from .cluster.slicepool import PoolPrewarmer
+        from .tpu import plan_slice
+
+        shape = plan_slice(
+            config.pool_prewarm_accelerator, config.pool_prewarm_topology
+        )
+        mgr.add_service(PoolPrewarmer(
+            mgr.client, shape.gke_accelerator, shape.topology,
+            target=config.pool_prewarm,
+            period_s=max(0.5, config.readiness_probe_period_s / 2),
+        ))
     if config.slo_enabled:
         _wire_observability(mgr, config)
     return mgr

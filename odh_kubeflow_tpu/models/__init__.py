@@ -6,9 +6,12 @@ exercised, benchmarked, and utilization-probed out of the box.
 """
 from .checkpoint import (
     latest_step,
+    logit_fingerprint,
     make_checkpoint_hook,
+    make_restore_hook,
     restore_train_state,
     save_train_state,
+    state_checksum,
 )
 from .decode import KVCache, decode_step, generate, init_cache, prefill
 from .moe import MoEConfig, moe_ffn, route_indices, route_topk
@@ -36,9 +39,12 @@ __all__ = [
     "init_cache",
     "prefill",
     "latest_step",
+    "logit_fingerprint",
     "make_checkpoint_hook",
+    "make_restore_hook",
     "restore_train_state",
     "save_train_state",
+    "state_checksum",
     "TransformerConfig",
     "forward",
     "init_params",

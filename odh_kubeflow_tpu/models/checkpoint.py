@@ -46,6 +46,45 @@ def latest_step(directory: str) -> Optional[int]:
         mngr.close()
 
 
+def state_checksum(state: Any) -> str:
+    """Deterministic digest of a state pytree (shapes + dtypes + bytes of
+    every leaf, in tree order). The restore-side verification contract
+    (ISSUE 9 satellite): the checkpoint hook acks this digest, the operator
+    stores it on the CR, and after resume / endpoint Loading the
+    /tpu/restore probe's digest must match — "the restored kernel equals
+    the saved one" asserted, not assumed."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(state):
+        arr = np.asarray(leaf)
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:16]
+
+
+def logit_fingerprint(params: Any, cfg: Any, prompt) -> str:
+    """Logit-parity probe digest: the prefill logits of a fixed prompt,
+    rounded to float32 and hashed. Weaker than state_checksum (it sees only
+    what the forward pass touches) but it verifies the MODEL as served —
+    the serving tests use it to assert a save->restore round trip changes
+    nothing the decode path can observe."""
+    import hashlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .decode import prefill
+
+    tokens = jnp.asarray([list(prompt)], jnp.int32)
+    logits, _ = prefill(params, tokens, cfg, tokens.shape[1])
+    arr = np.asarray(jax.device_get(logits), np.float32)
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()[:16]
+
+
 def make_checkpoint_hook(
     directory: str, state_provider: Any, max_to_keep: int = 3
 ):
@@ -57,12 +96,37 @@ def make_checkpoint_hook(
     `state_provider` returns (step, state_pytree) for the current run — the
     training loop typically closes over its latest step. Saves are per-shard
     (each host writes only what it owns), so driving the hook on every
-    ordinal of a multi-host slice is the correct, complete save."""
+    ordinal of a multi-host slice is the correct, complete save. The ack
+    carries the state checksum for restore-side verification."""
 
     def hook() -> dict:
         step, state = state_provider()
         save_train_state(directory, int(step), state, max_to_keep=max_to_keep)
-        return {"step": int(step)}
+        return {"step": int(step), "checksum": state_checksum(state)}
+
+    return hook
+
+
+def make_restore_hook(
+    directory: str, like_provider: Any, mesh=None
+):
+    """Restore hook for the in-pod probe agent's /tpu/restore endpoint: the
+    resumed notebook (or the promoted InferenceEndpoint in Loading) restores
+    the latest checkpoint onto `like_provider()`'s shardings and acks the
+    restored state's checksum, so the controller can compare it against the
+    digest the save acked."""
+
+    def hook() -> dict:
+        like = like_provider()
+        step = latest_step(directory)
+        if step is None:
+            return {"restored": False, "reason": f"no checkpoint under {directory!r}"}
+        state = restore_train_state(directory, like, step=step, mesh=mesh)
+        return {
+            "restored": True,
+            "step": int(step),
+            "checksum": state_checksum(state),
+        }
 
     return hook
 

@@ -396,6 +396,12 @@ class NotebookAgent:
         # and returns {"step": n}. None -> the endpoint reports saved=False
         # and the controller proceeds on window expiry instead of an ack.
         self.checkpoint_hook = checkpoint_hook
+        # restore-side verification contract (ISSUE 9): after resume — and
+        # during an InferenceEndpoint's Loading — the controller GETs
+        # /tpu/restore; the hook (models/checkpoint.py make_restore_hook)
+        # restores the latest checkpoint and acks {"restored", "step",
+        # "checksum"} so the restored kernel can be compared to the saved one
+        self.restore_hook: Optional[Any] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._serve_lock = racecheck.make_lock("NotebookAgent._serve_lock")
         self._closed = False
@@ -454,7 +460,27 @@ class NotebookAgent:
                 # repair controller treats a failed save as "proceed on
                 # window expiry" rather than blocking the evict forever
                 return {"saved": False, "reason": f"checkpoint hook failed: {e!r}"}
-            return {"saved": True, "step": out.get("step")}
+            return {
+                "saved": True,
+                "step": out.get("step"),
+                "checksum": out.get("checksum"),
+            }
+        if path.endswith("/tpu/restore"):
+            hook = self.restore_hook
+            if hook is None:
+                return {"restored": False, "reason": "no restore hook configured"}
+            try:
+                out = hook() or {}
+            except Exception as e:
+                # same degrade-into-the-response contract as the checkpoint
+                # hook: an unverifiable restore is reported, never a 500
+                return {"restored": False, "reason": f"restore hook failed: {e!r}"}
+            return {
+                "restored": bool(out.get("restored", True)),
+                "step": out.get("step"),
+                "checksum": out.get("checksum"),
+                "reason": out.get("reason"),
+            }
         if path.endswith("/tpu/utilization"):
             lb = self.monitor.last_busy()
             return {
@@ -589,7 +615,13 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
         return cold_start_s
 
     def behavior(pod):
-        if not pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL):
+        # notebook pods AND serving-endpoint pods (ISSUE 9): both run the
+        # same in-pod agent; the endpoint's readiness gate and restore
+        # verification ride the identical /tpu/* surface
+        if not (
+            pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
+            or pod.metadata.labels.get(C.INFERENCE_NAME_LABEL)
+        ):
             return None
         # keyed per container incarnation: a crash-restarted container (same
         # pod uid, restartCount bumped by the kubelet's crash injection) gets

@@ -153,7 +153,12 @@ class SLO:
 
 def default_slos() -> Tuple[SLO, ...]:
     """The operator's shipped objectives over series that PR 2/PR 4 already
-    emit (ci/slo_lint.sh checks every referenced family exists)."""
+    emit (ci/slo_lint.sh checks every referenced family exists). ISSUE 9
+    added the serving pair over the continuous-batching engine's families —
+    importing them here keeps the lint's live-registry contract honest on a
+    manager image that never loads the workload libraries."""
+    from ..serving import metrics as _serving_metrics  # noqa: F401
+
     return (
         SLO(
             "readiness-latency-p50",
@@ -211,6 +216,28 @@ def default_slos() -> Tuple[SLO, ...]:
             description="the fleet spends >= 98% of tracked slice-lifetime "
             "Ready rather than Degraded/Repairing",
             category="goodput",
+        ),
+        SLO(
+            "token-latency",
+            objective=0.95,
+            indicator=LatencyIndicator(
+                "inference_token_latency_seconds", 0.25
+            ),
+            description="95% of generated tokens land within 250ms of the "
+            "previous one (the continuous-batching engine's inter-token "
+            "gap; a saturated decode batch or admission stall burns this)",
+            category="serving",
+        ),
+        SLO(
+            "serving-availability",
+            objective=0.99,
+            indicator=EventRatioIndicator(
+                "inference_requests_total", good_labels=(("result", "ok"),)
+            ),
+            description="99% of serving requests complete (rejected "
+            "backpressure, errors, and drain-canceled requests burn the "
+            "budget — shedding load is visible, never free)",
+            category="serving",
         ),
     )
 

@@ -1,0 +1,8 @@
+"""Serving subsystem (ISSUE 9): the continuous-batching decode engine and
+its judgement metrics.
+
+``serving.metrics`` is dependency-free (no jax) so the operator, the SLO
+lint, and the controllers import it unconditionally; ``serving.engine``
+wraps models/decode.py and therefore needs the workload extra (jax) — import
+it lazily, the way the manager image never imports models/."""
+from . import metrics  # noqa: F401  (registers the serving metric families)

@@ -1,0 +1,503 @@
+"""Continuous-batching decode engine over models/decode.py (ISSUE 9).
+
+The static-batch `generate()` path compiles prefill + a fixed-length decode
+scan into one program: every sequence in the batch decodes for `max_new`
+steps whether it needs them or not, and no request can join until the whole
+batch retires. BENCH_r05 measured that shape at hbm_util 0.63 — decode is
+HBM-bound, so every step spent on a finished (or empty) slot is bandwidth
+the cluster paid for and nobody received. This engine converts that headroom
+into goodput under mixed-length request streams:
+
+- **Slot-based flat KV cache.** One (L, S, max_seq, kv_heads, head_dim)
+  cache pair; each of the S slots holds one live sequence with its OWN
+  length. Slots recycle the moment a sequence hits EOS/max-tokens — the
+  cache is reused in place, never reallocated.
+- **Prefill/decode scheduling.** Between decode steps the engine admits
+  queued requests into free slots: the prompt runs through the shared
+  `prefill()` (flash attention does the O(s²) work once) and its per-layer
+  K/V land in the slot via one `dynamic_update_slice`. The first token is
+  emitted straight from the prefill logits — TTFT does not wait for the
+  decode batch to come around.
+- **Whole-batch decode.** One jitted step advances every active slot one
+  token: per-slot positions (a vmapped in-place cache write at each slot's
+  own length), per-slot validity masks, grouped-query attention against the
+  un-repeated kv_heads cache — the same einsum shapes as
+  `models/decode._cached_attention`, so numerics match the single-notebook
+  decode path exactly (greedy parity is a test).
+- **Bounded admission queue.** `submit()` past `max_queue_depth` raises
+  `QueueFull` (counted `result="rejected"`) — backpressure is explicit and
+  lands in the serving-availability SLO instead of an unbounded queue
+  silently eating latency.
+
+Greedy decoding only: the engine is the operator's serving substrate and
+greedy keeps it bitwise-comparable to `decode_step`; sampling belongs to a
+temperature operand on the step function (the `generate()` idiom) when a
+workload needs it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.decode import NEG_INF, _finish_layer, prefill
+from ..models.transformer import TransformerConfig, layer_qkv
+from ..ops import rms_norm
+from ..tpu import telemetry
+from ..utils import racecheck
+from ..utils.tracing import record_span
+from . import metrics as M
+
+
+class QueueFull(RuntimeError):
+    """Admission queue at max_queue_depth: the caller sheds load (HTTP 429)
+    instead of the engine buffering unbounded latency."""
+
+
+@dataclass
+class RequestHandle:
+    """One in-flight generation request. `wait()` blocks until completion;
+    `tokens` is the generated sequence (never includes the prompt)."""
+
+    id: int
+    prompt: List[int]
+    max_new: int
+    submitted: float
+    traceparent: Optional[str] = None
+    tokens: List[int] = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+    result: str = ""  # ok | canceled
+    ttft_s: Optional[float] = None
+    _last_token_t: Optional[float] = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+
+def _slot_attention(q, k_cache, v_cache, valid, cfg: TransformerConfig):
+    """models/decode._cached_attention with a PER-SLOT validity mask
+    (slots sit at different sequence lengths). q: (S, 1, n_heads, hd);
+    k/v_cache: (S, max_seq, kv_heads, hd); valid: (S, max_seq) bool. The
+    einsum shapes match the batch-major decode path exactly, so each row's
+    numerics are identical to single-sequence decode."""
+    b = q.shape[0]
+    groups = cfg.n_heads // cfg.kv_heads
+    qg = q.reshape(b, 1, cfg.kv_heads, groups, cfg.head_dim)
+    scores = jnp.einsum(
+        "bqcgd,bkcd->bcgqk", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (cfg.head_dim**-0.5)
+    scores = jnp.where(valid[:, None, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum(
+        "bcgqk,bkcd->bqcgd", probs, v_cache, preferred_element_type=jnp.float32
+    ).astype(cfg.dtype)
+    return attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
+
+
+@partial(jax.jit, static_argnames=("cfg", "burst"), donate_argnums=(1,))
+def _decode_burst(params, caches, layers, lengths, tokens, remaining, eos,
+                  cfg, burst):
+    """`burst` decode steps for every slot in ONE compiled program — the
+    dispatch-amortization that makes continuous batching win under a
+    per-dispatch latency floor (bench.py's tunnel note: a host round trip
+    per token would hand the whole slot-recycling gain straight back).
+    Admission still happens every burst boundary, so the TTFT cost of a
+    burst is bounded at `burst` decode steps.
+
+    The loop body inherits the generate() layout lessons (models/decode.py
+    module docstring): `layers` is the PRE-SLICED per-layer weight views
+    (loop-invariant — a scan over the stacked (L, ...) params would copy
+    every layer's weights out of the stack on every token), FFN halves
+    pre-fused, and `caches` is a per-layer tuple of (S, max_seq, kv, hd)
+    buffers carried through the step scan so XLA aliases the one-token
+    updates in place (donated).
+
+    lengths (S,) per-slot positions; tokens (S,) the tokens being consumed;
+    remaining (S,) tokens still owed per slot (0 = inactive — a finished/
+    free slot computes masked garbage rather than forcing a per-occupancy
+    recompile; the next prefill insert replaces its whole cache extent).
+    `eos` ends a sequence early on device (-1 = disabled). Returns the
+    per-step emitted tokens and active masks, (burst, S) each.
+    """
+    max_seq = caches[0][0].shape[1]
+
+    def write(cache, new, pos):
+        # per-slot in-place write at each slot's OWN position
+        return jax.vmap(
+            lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0, 0))
+        )(cache, new, pos)
+
+    def one_step(carry, _):
+        caches, lengths, tokens, remaining = carry
+        active = remaining > 0
+        positions = lengths[:, None]  # (S, 1) — per-slot rope positions
+        x = params["embed"].astype(cfg.dtype)[tokens][:, None, :]
+        valid = jnp.arange(max_seq)[None, :] <= lengths[:, None]
+        new_caches = []
+        for layer_params, (k_cache, v_cache) in zip(layers, caches):
+            q, k, v = layer_qkv(x, layer_params, positions, cfg)
+            k_cache = write(k_cache, k, lengths)
+            v_cache = write(v_cache, v, lengths)
+            attn = _slot_attention(q, k_cache, v_cache, valid, cfg)
+            x = _finish_layer(x, attn, layer_params, cfg)
+            new_caches.append((k_cache, v_cache))
+        x = rms_norm(x, params["final_norm"])
+        logits = jnp.einsum(
+            "bd,dv->bv", x[:, 0], params["unembed"],
+            preferred_element_type=jnp.float32,
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        emitted = jnp.where(active, nxt, tokens)
+        done = active & ((emitted == eos) | (remaining <= 1))
+        remaining = jnp.where(active, remaining - 1, remaining)
+        remaining = jnp.where(done, 0, remaining)
+        lengths = lengths + active.astype(jnp.int32)
+        return (tuple(new_caches), lengths, emitted, remaining), (
+            emitted, active,
+        )
+
+    (caches, lengths, tokens, remaining), (toks, actives) = lax.scan(
+        one_step, (caches, lengths, tokens, remaining), None, length=burst
+    )
+    return caches, lengths, tokens, remaining, toks, actives
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_seq"))
+def _prefill_jit(params, tokens, cfg, max_seq):
+    """One compiled program per distinct prompt length (decode.py's prefill
+    is deliberately un-jitted — generate() jits around it; an engine
+    admitting a request per call must jit here or pay eager per-op dispatch
+    on every admission: measured ~70x the whole-burst cost)."""
+    return prefill(params, tokens, cfg, max_seq)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _insert_slot(caches, ck, cv, slot):
+    """Land a prefilled sequence's K/V (stacked (L, 1, max_seq, kv, hd)
+    from prefill()) into cache slot `slot` of every per-layer buffer. The
+    whole slot extent is replaced, so a recycled slot's stale garbage never
+    survives into the next sequence."""
+    out = []
+    for l, (k_cache, v_cache) in enumerate(caches):
+        out.append((
+            lax.dynamic_update_slice(k_cache, ck[l], (slot, 0, 0, 0)),
+            lax.dynamic_update_slice(v_cache, cv[l], (slot, 0, 0, 0)),
+        ))
+    return tuple(out)
+
+
+class ServingEngine:
+    """The in-pod serving loop. Thread-safe submit; `step()` is the
+    deterministic unit (admit free slots, decode the active batch once) the
+    tests drive directly; `start()` runs it on a daemon thread for the
+    loadtest/bench shape."""
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: TransformerConfig,
+        *,
+        max_slots: int = 8,
+        max_seq: int = 512,
+        max_queue_depth: int = 64,
+        eos_id: Optional[int] = None,
+        decode_burst: int = 8,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        if max_slots <= 0 or max_seq <= 0:
+            raise ValueError("max_slots and max_seq must be positive")
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_seq = max_seq
+        self.max_queue_depth = max_queue_depth
+        self.eos_id = eos_id
+        # decode steps per dispatch: the prefill/decode scheduling knob.
+        # 1 = admit every token (lowest queue wait, one host round trip per
+        # token); higher amortizes the dispatch floor over the burst while
+        # bounding admission delay at `decode_burst` steps.
+        self.decode_burst = max(1, decode_burst)
+        self.clock = clock
+        # per-layer (S, max_seq, kv, hd) cache buffers + pre-sliced,
+        # FFN-fused weight views — the generate() loop layout (decode.py)
+        slot_shape = (max_slots, max_seq, cfg.kv_heads, cfg.head_dim)
+        self._caches = tuple(
+            (jnp.zeros(slot_shape, cfg.dtype), jnp.zeros(slot_shape, cfg.dtype))
+            for _ in range(cfg.n_layers)
+        )
+
+        def view(layer):
+            lp = jax.tree_util.tree_map(
+                lambda a: a[layer], params["layers"]
+            )
+            if cfg.moe is None and "wi_gate" in lp:
+                lp["wi_fused"] = jnp.concatenate(
+                    [lp["wi_gate"], lp["wi_up"]], axis=-1
+                )
+            return lp
+
+        self._layers = tuple(view(layer) for layer in range(cfg.n_layers))
+        self._lengths = np.zeros((max_slots,), np.int32)
+        self._tokens = np.zeros((max_slots,), np.int32)
+        self._remaining = np.zeros((max_slots,), np.int32)
+        self._slots: List[Optional[RequestHandle]] = [None] * max_slots
+        self._queue: Deque[RequestHandle] = deque()
+        self._lock = racecheck.make_lock("ServingEngine._lock")
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._next_id = 0
+        self._generated_total = 0
+        self._decode_steps = 0
+        self._busy_s = 0.0
+
+    # ---------- submission ----------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new: int,
+        traceparent: Optional[str] = None,
+    ) -> RequestHandle:
+        if max_new <= 0:
+            raise ValueError("max_new must be positive")
+        if len(prompt) + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new ({max_new}) exceeds the "
+                f"slot cache extent ({self.max_seq})"
+            )
+        with self._lock:
+            if len(self._queue) >= self.max_queue_depth:
+                M.inference_requests_total.inc(result="rejected")
+                raise QueueFull(
+                    f"admission queue at max_queue_depth "
+                    f"({self.max_queue_depth})"
+                )
+            self._next_id += 1
+            handle = RequestHandle(
+                id=self._next_id,
+                prompt=list(prompt),
+                max_new=max_new,
+                submitted=self.clock(),
+                traceparent=traceparent,
+            )
+            self._queue.append(handle)
+            M.inference_queue_depth.set(float(len(self._queue)))
+        self._work.set()
+        return handle
+
+    # ---------- the engine iteration ----------
+
+    def step(self) -> bool:
+        """Admit queued requests into free slots, then run one decode BURST
+        (`decode_burst` tokens per active slot in a single dispatch).
+        Returns False when there was nothing to do."""
+        admitted = self._admit()
+        n_active = sum(h is not None for h in self._slots)
+        if n_active == 0:
+            self._publish_gauges()
+            return bool(admitted)
+        burst = self.decode_burst
+        t0 = self.clock()
+        (
+            self._caches, lengths, tokens, remaining, toks, actives
+        ) = _decode_burst(
+            self.params,
+            self._caches,
+            self._layers,
+            jnp.asarray(self._lengths),
+            jnp.asarray(self._tokens),
+            jnp.asarray(self._remaining),
+            jnp.asarray(
+                self.eos_id if self.eos_id is not None else -1, jnp.int32
+            ),
+            self.cfg,
+            burst,
+        )
+        # np.array (copy): device_get hands back read-only views, and the
+        # admission path writes these slots in place
+        self._lengths = np.array(jax.device_get(lengths))
+        self._tokens = np.array(jax.device_get(tokens))
+        self._remaining = np.array(jax.device_get(remaining))
+        toks = np.asarray(jax.device_get(toks))
+        actives = np.asarray(jax.device_get(actives))
+        now = self.clock()
+        burst_dt = now - t0
+        self._busy_s += burst_dt
+        self._decode_steps += burst
+        per_step = burst_dt / burst
+        telemetry.observe_decode_step(per_step, tokens=n_active)
+        for t in range(burst):
+            step_t = t0 + (t + 1) * per_step
+            for j, handle in enumerate(self._slots):
+                if handle is None or not actives[t, j]:
+                    continue
+                self._emit(j, handle, int(toks[t, j]), step_t)
+        self._publish_gauges()
+        return True
+
+    def _admit(self) -> int:
+        """Prefill queued requests into free KV-cache slots. Runs BETWEEN
+        decode steps — a full decode batch never blocks admission for longer
+        than one step."""
+        admitted = 0
+        while True:
+            free = next(
+                (j for j, h in enumerate(self._slots) if h is None), None
+            )
+            if free is None:
+                return admitted
+            with self._lock:
+                if not self._queue:
+                    return admitted
+                handle = self._queue.popleft()
+                M.inference_queue_depth.set(float(len(self._queue)))
+            prompt = jnp.asarray([handle.prompt], jnp.int32)
+            logits, cache = _prefill_jit(
+                self.params, prompt, self.cfg, self.max_seq
+            )
+            self._caches = _insert_slot(
+                self._caches, cache.k, cache.v, jnp.asarray(free, jnp.int32)
+            )
+            first = int(jax.device_get(jnp.argmax(logits, axis=-1))[0])
+            now = self.clock()
+            handle.ttft_s = now - handle.submitted
+            M.inference_ttft_seconds.observe(handle.ttft_s)
+            self._slots[free] = handle
+            self._lengths[free] = len(handle.prompt)
+            # first token came straight from the prefill logits: the decode
+            # burst owes max_new - 1 more
+            self._remaining[free] = handle.max_new - 1
+            self._emit(free, handle, first, now)
+            if self._slots[free] is None:
+                # finished at admission (max_new == 1, or an immediate EOS):
+                # the device must not decode into the freed slot
+                self._remaining[free] = 0
+            admitted += 1
+
+    def _emit(self, slot: int, handle: RequestHandle, token: int,
+              now: float) -> None:
+        """One generated token for `handle`: record it, observe the
+        inter-token gap, recycle the slot on EOS/max-tokens."""
+        handle.tokens.append(token)
+        if handle._last_token_t is not None:
+            M.inference_token_latency_seconds.observe(
+                max(0.0, now - handle._last_token_t)
+            )
+        handle._last_token_t = now
+        self._generated_total += 1
+        finished = len(handle.tokens) >= handle.max_new or (
+            self.eos_id is not None and token == self.eos_id
+        )
+        if finished:
+            self._slots[slot] = None  # recycled; prefill overwrites the cache
+            self._complete(handle, "ok", now)
+        else:
+            self._tokens[slot] = token
+
+    def _complete(self, handle: RequestHandle, result: str,
+                  now: float) -> None:
+        handle.result = result
+        M.inference_requests_total.inc(result=result)
+        record_span(
+            "inference.request",
+            traceparent=handle.traceparent,
+            start_time=handle.submitted,
+            end_time=now,
+            request_id=handle.id,
+            tokens=len(handle.tokens),
+            ttft_s=round(handle.ttft_s, 6) if handle.ttft_s is not None
+            else None,
+            result=result,
+        )
+        handle.done.set()
+
+    def _publish_gauges(self) -> None:
+        occupied = sum(h is not None for h in self._slots)
+        M.inference_slot_occupancy_ratio.set(occupied / self.max_slots)
+        if self._busy_s > 0:
+            M.inference_goodput_tokens_per_s.set(
+                self._generated_total / self._busy_s
+            )
+
+    # ---------- lifecycle ----------
+
+    def idle(self) -> bool:
+        with self._lock:
+            queued = bool(self._queue)
+        return not queued and all(h is None for h in self._slots)
+
+    def run_until_idle(self, timeout: float = 60.0) -> bool:
+        """Drive steps on the CALLING thread until queue and slots drain
+        (the deterministic test/bench loop; don't mix with start())."""
+        deadline = time.monotonic() + timeout
+        while not self.idle():
+            if time.monotonic() > deadline:
+                return False
+            self.step()
+        return True
+
+    def start(self) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serving-engine"
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            did_work = self.step()
+            if not did_work and self.idle():
+                self._work.wait(timeout=0.01)
+                self._work.clear()
+
+    def stop(self, drain_timeout_s: float = 0.0) -> None:
+        """Stop the loop. With a drain timeout the engine keeps stepping
+        until in-flight work completes (Draining); whatever remains is
+        completed as `canceled` — requests fail fast, never hang."""
+        thread = self._thread
+        if thread is not None:
+            self._stop.set()
+            self._work.set()
+            thread.join(timeout=5.0)
+            self._thread = None
+        if drain_timeout_s > 0:
+            self.run_until_idle(timeout=drain_timeout_s)
+        now = self.clock()
+        with self._lock:
+            leftovers = list(self._queue)
+            self._queue.clear()
+            M.inference_queue_depth.set(0.0)
+        for j, handle in enumerate(self._slots):
+            if handle is not None:
+                self._slots[j] = None
+                leftovers.append(handle)
+        for handle in leftovers:
+            self._complete(handle, "canceled", now)
+        self._publish_gauges()
+
+    # ---------- introspection ----------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            queued = len(self._queue)
+        return {
+            "queued": queued,
+            "active_slots": sum(h is not None for h in self._slots),
+            "max_slots": self.max_slots,
+            "generated_tokens": self._generated_total,
+            "decode_steps": self._decode_steps,
+            "busy_s": round(self._busy_s, 6),
+        }

@@ -1,0 +1,66 @@
+"""Serving-side metric families (ISSUE 9) — the judgement surface of the
+continuous-batching engine.
+
+Deliberately jax-free: these register into the global registry at import so
+the SLO engine's `token-latency` / `serving-availability` objectives and
+`ci/slo_lint.sh` see the families even on a manager image that never loads
+the workload libraries. The engine (serving/engine.py) feeds them; the
+controller (controllers/inference.py) and the loadtest read them only
+through the SLO machinery — pass/fail is burn rate, not ad-hoc thresholds.
+"""
+from __future__ import annotations
+
+from ..runtime.metrics import global_registry
+
+# TTFT: submit -> first generated token (prefill admission wait + prefill
+# compute). The continuous-batching promise is that admission happens
+# between decode steps, so TTFT stays bounded under a full decode batch.
+inference_ttft_seconds = global_registry.histogram(
+    "inference_ttft_seconds",
+    "Time to first token per request: submit -> first generated token "
+    "(queue wait + prefill)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0),
+)
+inference_token_latency_seconds = global_registry.histogram(
+    "inference_token_latency_seconds",
+    "Per-token decode latency (inter-token gap) per active sequence — the "
+    "token-latency SLO judges the 0.25s bucket",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5),
+)
+inference_goodput_tokens_per_s = global_registry.gauge(
+    "inference_goodput_tokens_per_s",
+    "Cumulative generated tokens per second of engine wall time — the "
+    "continuous-batching headline the bench compares against the "
+    "static-batch decode baseline",
+)
+inference_queue_depth = global_registry.gauge(
+    "inference_queue_depth",
+    "Requests waiting in the bounded admission queue (backpressure rejects "
+    "past spec.serving.maxQueueDepth)",
+)
+inference_slot_occupancy_ratio = global_registry.gauge(
+    "inference_slot_occupancy_ratio",
+    "Active KV-cache slots / total slots (the idle-HBM headroom continuous "
+    "batching exists to convert into goodput)",
+)
+inference_requests_total = global_registry.counter(
+    "inference_requests_total",
+    "Serving requests by terminal result: ok (completed), rejected "
+    "(admission-queue backpressure), error, canceled (engine stopped "
+    "mid-request) — the serving-availability SLO's good/total ratio",
+    labels=("result",),
+)
+inference_endpoint_promotions_total = global_registry.counter(
+    "inference_endpoint_promotions_total",
+    "Notebook->endpoint promotions by bind path: warm (claimed the source "
+    "notebook's pooled slice) or cold (fresh placement)",
+    labels=("bind",),
+)
+inference_restore_verifications_total = global_registry.counter(
+    "inference_restore_verifications_total",
+    "Endpoint-side checkpoint restore verifications by result (ok / "
+    "mismatch / unverified)",
+    labels=("result",),
+)

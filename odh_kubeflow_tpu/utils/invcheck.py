@@ -90,16 +90,20 @@ def _annotations(obj: Optional[dict]) -> Dict[str, str]:
 
 
 def check_machine_transitions(ctx: WriteContext) -> Optional[str]:
-    """Observed Notebook state-annotation changes must be declared
-    transitions (analysis/machines.py — the same specs the static
-    machine-conformance checker enforces on the write SITES)."""
-    if ctx.kind != "Notebook":
+    """Observed state-annotation changes must be declared transitions
+    (analysis/machines.py — the same specs the static machine-conformance
+    checker enforces on the write SITES). Each machine is judged only
+    against writes of its own kind: the suspend/repair/culling machines on
+    Notebooks, the inference machine on InferenceEndpoints."""
+    if ctx.kind not in ("Notebook", "InferenceEndpoint"):
         return None
     from ..analysis.machines import MACHINES
     from ..controllers import constants as C
 
     old_ann, new_ann = _annotations(ctx.old), _annotations(ctx.new)
     for spec in MACHINES:
+        if spec.kind != ctx.kind:
+            continue
         key = getattr(C, spec.annotation)
         old_state = spec.classify_value(
             old_ann.get(key), dynamic=False

@@ -152,21 +152,35 @@ def test_make_checkpoint_hook_saves_and_reports_step(tmp_path):
     directory = str(tmp_path / "ckpt")
     hook = make_checkpoint_hook(directory, lambda: (7, state))
 
+    from odh_kubeflow_tpu.models import state_checksum
+
     out = hook()
-    assert out == {"step": 7}
+    # the ack carries the state digest for restore-side verification
+    # (ISSUE 9): the operator stores it and /tpu/restore must reproduce it
+    assert out == {"step": 7, "checksum": state_checksum(state)}
     assert latest_step(directory) == 7
     restored = restore_train_state(directory, state)
     np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(8, dtype=np.float32))
     assert float(restored["b"]) == 3.0
+    assert state_checksum(restored) == out["checksum"]
 
     # the agent endpoint contract end-to-end: GET /tpu/checkpoint drives the
-    # hook and reports {"saved": true, "step": N}
+    # hook and reports {"saved": true, "step": N, "checksum": digest}; the
+    # restore hook answers /tpu/restore with the same digest
+    from odh_kubeflow_tpu.models import make_restore_hook
     from odh_kubeflow_tpu.probe import NotebookAgent, SimTPUMonitor
 
     agent = NotebookAgent(monitor=SimTPUMonitor(), checkpoint_hook=hook)
-    assert agent.routes("/tpu/checkpoint") == {"saved": True, "step": 7}
+    assert agent.routes("/tpu/checkpoint") == {
+        "saved": True, "step": 7, "checksum": out["checksum"],
+    }
+    agent.restore_hook = make_restore_hook(directory, lambda: state)
+    rack = agent.routes("/tpu/restore")
+    assert rack["restored"] is True and rack["step"] == 7
+    assert rack["checksum"] == out["checksum"]
     agent_nohook = NotebookAgent(monitor=SimTPUMonitor())
     assert agent_nohook.routes("/tpu/checkpoint")["saved"] is False
+    assert agent_nohook.routes("/tpu/restore")["restored"] is False
 
 
 def test_reinitialize_after_repair_single_host_noop():

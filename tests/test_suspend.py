@@ -734,3 +734,206 @@ def test_oversubscription_soak_degrades_by_suspending_not_failing():
         mgr.stop()
         cluster.stop()
         cluster.faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# restore-side verification after resume (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+
+def _restore_verify_env(restore_ack):
+    """Suspend env whose transport answers /tpu/restore deterministically:
+    arming per-incarnation agent hooks from a polling loop races the
+    controller's one-shot resume-time verification probe (and loses on a
+    fast machine) — the transport answer can't."""
+    import json as _json
+
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=2)
+
+    def http_get(url, timeout=10.0):
+        if "/tpu/restore" in url:
+            return 200, _json.dumps(restore_ack).encode()
+        return cluster.http_get(url, timeout=timeout)
+
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, FAST).setup()
+    ProbeStatusController(mgr, FAST, http_get=cluster.http_get).setup()
+    SuspendResumeController(mgr, FAST, http_get=http_get).setup()
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    mgr.start()
+    return cluster, mgr, agents
+
+
+def _drive_verified_resume(name, saved_checksum, restore_ack):
+    from odh_kubeflow_tpu.cluster.slicepool import (
+        notebook_restore_verifications_total,
+    )
+
+    cluster, mgr, agents = _restore_verify_env(restore_ack)
+    try:
+        cluster.client.create(mk_nb(name))
+        wait_for(lambda: mesh_ready(cluster, name), msg="bring-up")
+        agents[f"{name}-0"].checkpoint_hook = (
+            lambda: {"step": restore_ack.get("step"),
+                     "checksum": saved_checksum}
+        )
+        stop(cluster, name)
+        wait_for(
+            lambda: suspend_state(cluster, name) == "suspended"
+            and not pods_of(cluster, name),
+            msg="suspended",
+        )
+        nb = get_nb(cluster, name)
+        # the checkpoint ack's digest is durable on the CR
+        assert nb.metadata.annotations.get(
+            C.TPU_CHECKPOINT_CHECKSUM_ANNOTATION) == saved_checksum
+        unstop(cluster, name)
+        wait_for(lambda: active(cluster, name), msg="resumed")
+        assert mgr.healthz()
+        return cluster, mgr, notebook_restore_verifications_total
+    except BaseException:
+        mgr.stop()
+        cluster.stop()
+        raise
+
+
+def test_resume_verifies_restored_kernel():
+    ack = {"restored": True, "step": 11, "checksum": "feedface"}
+    cluster, mgr, counter = _drive_verified_resume("verified", "feedface", ack)
+    try:
+        wait_for(lambda: has_event(cluster, "RestoreVerified", "verified"),
+                 msg="RestoreVerified event")
+        assert counter.value(result="ok") >= 1
+        assert not has_event(cluster, "RestoreVerifyFailed", "verified")
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
+def test_resume_restore_mismatch_is_loud():
+    # the restored kernel does NOT match what was saved; the resume still
+    # COMPLETES (live-but-suspect beats wedged) but the mismatch is loud
+    ack = {"restored": True, "step": 3, "checksum": "bbbb"}
+    cluster, mgr, counter = _drive_verified_resume("tainted", "aaaa", ack)
+    try:
+        wait_for(lambda: has_event(cluster, "RestoreVerifyFailed", "tainted"),
+                 msg="RestoreVerifyFailed event")
+        assert counter.value(result="mismatch") >= 1
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# reclaimer vs serving endpoints (ISSUE 9 bugfix)
+# ---------------------------------------------------------------------------
+
+
+def _mk_ep(name, priority=0, drain_s=30.0):
+    from odh_kubeflow_tpu.api.inference import InferenceEndpoint, ServingSpec
+    from odh_kubeflow_tpu.api.notebook import TPUSpec as _TPUSpec
+
+    ep = InferenceEndpoint()
+    ep.metadata.name = name
+    ep.metadata.namespace = NS
+    ep.spec.template.spec.containers = [Container(name=name, image="serve:1")]
+    ep.spec.tpu = _TPUSpec(accelerator="v5e", topology="2x2",
+                           priority=priority)
+    ep.spec.serving = ServingSpec(drain_timeout_s=drain_s)
+    return ep
+
+
+def _build_serving_env(config, slices):
+    from odh_kubeflow_tpu.controllers import InferenceEndpointReconciler
+
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=slices)
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, config).setup()
+    ProbeStatusController(mgr, config, http_get=cluster.http_get).setup()
+    SuspendResumeController(mgr, config, http_get=cluster.http_get).setup()
+    InferenceEndpointReconciler(mgr, config, http_get=cluster.http_get).setup()
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    mgr.start()
+    return cluster, mgr, agents
+
+
+def test_reclaimer_treats_endpoints_by_priority_and_spares_draining():
+    """ISSUE 9 bugfix, both halves: (a) a Serving endpoint's DEFAULT
+    priority sits above interactive notebooks, so the notebook is the
+    victim even when the endpoint's explicit priority field is 0; (b) a
+    Draining endpoint is never re-victimized mid-drain."""
+    from odh_kubeflow_tpu.api.inference import InferenceEndpoint
+
+    config = Config(
+        enable_culling=False,
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        suspend_checkpoint_window_s=1.0,
+        resume_timeout_s=10.0,
+        resume_max_attempts=4,
+        reclaim_pending_grace_s=0.3,
+        serving_loading_window_s=8.0,
+        serving_drain_timeout_s=30.0,  # a LONG drain: mid-drain is observable
+    )
+    cluster, mgr, agents = _build_serving_env(config, slices=2)
+    try:
+        # slice 1: a Serving endpoint with priority UNSET (defaults to
+        # ENDPOINT_DEFAULT_PRIORITY=10); slice 2: an interactive notebook at
+        # priority 2 — above the endpoint's raw field, below its default
+        cluster.client.create(_mk_ep("live-traffic"))
+        wait_for(
+            lambda: cluster.client.get(InferenceEndpoint, NS, "live-traffic")
+            .metadata.annotations.get(C.INFERENCE_STATE_ANNOTATION)
+            == "serving",
+            timeout=40, msg="endpoint Serving",
+        )
+        cluster.client.create(mk_nb("idler", priority=2))
+        wait_for(lambda: mesh_ready(cluster, "idler"), msg="notebook up")
+        agents["idler-0"].checkpoint_hook = lambda: {"step": 1}
+
+        # a priority-5 notebook arrives into a full cluster: the victim MUST
+        # be the notebook (priority 2), never the endpoint (default 10)
+        cluster.client.create(mk_nb("vip", priority=5))
+        wait_for(lambda: mesh_ready(cluster, "vip"), timeout=40,
+                 msg="vip placed via reclaim")
+        assert suspend_state(cluster, "idler") in ("checkpointing", "suspended")
+        ep = cluster.client.get(InferenceEndpoint, NS, "live-traffic")
+        assert ep.metadata.annotations.get(
+            C.INFERENCE_STATE_ANNOTATION) == "serving", (
+            "the reclaimer victimized a Serving endpoint that outranked "
+            "the requester"
+        )
+        assert C.STOP_ANNOTATION not in ep.metadata.annotations
+
+        # now STOP the endpoint (enters its LONG drain window) and apply
+        # fresh pressure: the Draining endpoint must never be re-stamped
+        wait_for(lambda: suspend_state(cluster, "idler") == "suspended",
+                 timeout=40, msg="idler parked")
+        cluster.client.patch(
+            InferenceEndpoint, NS, "live-traffic",
+            {"metadata": {"annotations": {
+                C.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+            }}},
+        )
+        wait_for(
+            lambda: cluster.client.get(InferenceEndpoint, NS, "live-traffic")
+            .metadata.annotations.get(C.INFERENCE_STATE_ANNOTATION)
+            == "draining",
+            timeout=20, msg="endpoint Draining",
+        )
+        cluster.client.create(mk_nb("vip2", priority=9))
+        time.sleep(2.0)
+        ep = cluster.client.get(InferenceEndpoint, NS, "live-traffic")
+        assert ep.metadata.annotations.get(
+            C.TPU_RECLAIM_ANNOTATION, "") == "", (
+            "a Draining endpoint was re-victimized mid-drain"
+        )
+        assert mgr.healthz()
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
