@@ -1069,6 +1069,232 @@ def _bench_suspend_resume(notebooks=6, cycles=2, cold_start_s=0.75):
     }
 
 
+def _bench_batch_contention():
+    """Three-way contention episode (ISSUE 10): batch TPUJobs + notebook
+    churn + a serving endpoint inside ONE chip budget. Phase A runs the
+    jobs alone (the no-contention baseline); phase B adds an endpoint
+    pinned Serving and an interactive notebook whose arrival reclaims a
+    job's slice (checkpoint-preempt-requeue) and whose suspension hands it
+    back warm. Reports the goodput ratio vs baseline and the preemption
+    survival rate — 1.0 means every preempted job resumed from a step its
+    workload actually acked and still completed."""
+    import json as _json
+
+    from odh_kubeflow_tpu.api.core import Container
+    from odh_kubeflow_tpu.api.job import TPUJob
+    from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config, constants as CC
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.runtime import jobmetrics as JM
+
+    NS = "batch"
+    JOBS = ["rl-0", "rl-1"]
+    # ~16 cadence checkpoints per job: long enough that the interactive
+    # arrival lands mid-run (a job finishing before the reclaim would turn
+    # the episode into an idle-warm claim, not a preemption)
+    STEPS, STEP_PER_CKPT = 480, 30
+
+    def run_phase(contention):
+        cluster = SimCluster().start()
+        cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=3)  # 12 chips
+        acked = {name: [] for name in JOBS}
+        steps = {name: 0 for name in JOBS}
+
+        def http_get(url, timeout=10.0):
+            if "/tpu/checkpoint" in url:
+                for name in JOBS:
+                    if f"{name}-learner" in url:
+                        steps[name] += STEP_PER_CKPT
+                        acked[name].append(steps[name])
+                        return 200, _json.dumps(
+                            {"saved": True, "step": steps[name]}
+                        ).encode()
+                # the churn notebook's suspend checkpoint: ack instantly
+                return 200, _json.dumps({"saved": True, "step": 1}).encode()
+            return cluster.http_get(url, timeout=timeout)
+
+        config = Config(
+            enable_culling=False, suspend_enabled=True,
+            readiness_probe_period_s=0.15,
+            suspend_checkpoint_window_s=1.0, resume_timeout_s=20.0,
+            # budget 16 over 12 physical chips: the fourth workload is
+            # ADMITTED demand (oversubscription), so pressure degrades into
+            # preemption — a 12 budget would just queue the notebook
+            reclaim_pending_grace_s=0.3, chip_budget=16,
+            serving_loading_window_s=10.0, serving_drain_timeout_s=0.5,
+            job_checkpoint_window_s=2.0, job_requeue_backoff_s=0.2,
+            slo_enabled=False, canary_period_s=0.0,
+        )
+        mgr = build_manager(cluster.store, config, http_get=http_get)
+        agents = {}
+        cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+        mgr.start()
+
+        def wait(fn, timeout, what):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if fn():
+                    return
+                time.sleep(0.02)
+            raise SystemExit(f"batch episode: timeout on {what}")
+
+        def job_state(name):
+            return cluster.client.get(TPUJob, NS, name) \
+                .metadata.annotations.get(CC.JOB_STATE_ANNOTATION, "")
+
+        goodput0 = dict(JM._goodput)
+        t0 = time.monotonic()
+        try:
+            if contention:
+                from odh_kubeflow_tpu.api.inference import (
+                    InferenceEndpoint, ServingSpec,
+                )
+
+                ep = InferenceEndpoint()
+                ep.metadata.name = "serve"
+                ep.metadata.namespace = NS
+                ep.spec.template.spec.containers = [
+                    Container(name="serve", image="serve:1")
+                ]
+                ep.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+                ep.spec.serving = ServingSpec(max_batch_slots=4,
+                                              max_queue_depth=16)
+                cluster.client.create(ep)
+                wait(
+                    lambda: cluster.client.get(
+                        InferenceEndpoint, NS, "serve"
+                    ).metadata.annotations.get(
+                        CC.INFERENCE_STATE_ANNOTATION
+                    ) == "serving",
+                    40, "endpoint Serving",
+                )
+
+            for name in JOBS:
+                job = TPUJob()
+                job.metadata.name = name
+                job.metadata.namespace = NS
+                job.spec.template.spec.containers = [
+                    Container(name=name, image="jax:1")
+                ]
+                job.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+                job.spec.steps = STEPS
+                job.spec.checkpoint_period_s = 0.4
+                cluster.client.create(job)
+            for name in JOBS:
+                wait(lambda n=name: job_state(n) == "running", 40,
+                     f"{name} running")
+
+            if contention:
+                # the interactive user arrives: priority 0 > batch -10 —
+                # the reclaimer checkpoint-preempts one job for the slice
+                nb = Notebook()
+                nb.metadata.name = "user"
+                nb.metadata.namespace = NS
+                nb.spec.template.spec.containers = [
+                    Container(name="user", image="jupyter:latest")
+                ]
+                nb.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+                cluster.client.create(nb)
+                wait(
+                    lambda: any(
+                        int(cluster.client.get(TPUJob, NS, n)
+                            .metadata.annotations.get(
+                                CC.JOB_PREEMPTIONS_ANNOTATION, "0") or 0)
+                        for n in JOBS
+                    ),
+                    30, "a job preempted for the notebook",
+                )
+                wait(
+                    lambda: (lambda got: got.status.tpu is not None
+                             and got.status.tpu.mesh_ready)(
+                        cluster.client.get(Notebook, NS, "user")),
+                    40, "notebook on the reclaimed slice",
+                )
+                # ...and goes idle: suspend hands the slice back warm, the
+                # preempted job warm-claims it and resumes from its step
+                cluster.client.patch(Notebook, NS, "user", {"metadata": {
+                    "annotations": {
+                        CC.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+                        CC.TPU_SUSPEND_STATE_ANNOTATION: "checkpointing",
+                    }}})
+
+            # bounded, non-fatal completion wait: a preempted job that
+            # never resumes must show up as survival < 1.0, not as a bench
+            # error — the survival rate has to be falsifiable
+            deadline = time.monotonic() + 90
+            final = {}
+            while time.monotonic() < deadline and len(final) < len(JOBS):
+                for name in JOBS:
+                    if name not in final:
+                        state = job_state(name)
+                        if state in ("succeeded", "failed"):
+                            final[name] = state
+                time.sleep(0.05)
+            elapsed = time.monotonic() - t0
+
+            preempted = survived = 0
+            resumes_honest = True
+            for name in JOBS:
+                job = cluster.client.get(TPUJob, NS, name)
+                ann = job.metadata.annotations
+                n_preempt = int(
+                    ann.get(CC.JOB_PREEMPTIONS_ANNOTATION, "0") or 0
+                )
+                if n_preempt:
+                    preempted += 1
+                    if final.get(name) != "succeeded":
+                        continue  # did not survive: burns the rate
+                    survived += 1
+                    resume_step = int(
+                        ann.get(CC.JOB_RESUME_STEP_ANNOTATION, "0") or 0
+                    )
+                    # the resumed-from step must be one the workload ACKED
+                    if resume_step not in acked[name]:
+                        resumes_honest = False
+            incomplete = sorted(set(JOBS) - set(final))
+        finally:
+            mgr.stop()
+            cluster.stop()
+
+        dp = JM._goodput["productive_s"] - goodput0["productive_s"]
+        dw = JM._goodput["wall_s"] - goodput0["wall_s"]
+        return {
+            "goodput_ratio": round(dp / dw, 4) if dw else None,
+            "wall_s": round(elapsed, 3),
+            "jobs": len(JOBS),
+            "preempted": preempted,
+            "survival": (survived / preempted) if preempted else None,
+            "resumes_from_acked_step": resumes_honest,
+            "incomplete": incomplete,
+        }
+
+    baseline = run_phase(contention=False)
+    contended = run_phase(contention=True)
+    survival = contended["survival"]
+    return {
+        "job_goodput_ratio": contended["goodput_ratio"],
+        "job_goodput_ratio_no_contention": baseline["goodput_ratio"],
+        "goodput_vs_no_contention": round(
+            contended["goodput_ratio"] / baseline["goodput_ratio"], 4
+        ) if baseline["goodput_ratio"] and contended["goodput_ratio"]
+        else None,
+        "preemption_survival_rate": survival,
+        "resumes_from_acked_step": contended["resumes_from_acked_step"],
+        "preempted_jobs": contended["preempted"],
+        "incomplete_jobs": contended["incomplete"],
+        "wall_s": {"no_contention": baseline["wall_s"],
+                   "contention": contended["wall_s"]},
+        "note": "scripted three-way episode: 2 batch jobs + 1 interactive "
+        "notebook + 1 serving endpoint, 16-chip budget over 12 "
+        "physical; the "
+        "notebook's arrival checkpoint-preempts a job (priority -10 < 0), "
+        "its suspension hands the slice back warm, the job requeues and "
+        "resumes from its acked step",
+    }
+
+
 def bench_control_plane():
     from odh_kubeflow_tpu.api.core import Container
     from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
@@ -1169,6 +1395,15 @@ def bench_control_plane():
     except Exception as e:
         suspend_resume = {"error": repr(e)[:300]}
 
+    # batch contention (ISSUE 10): jobs + notebook churn + an endpoint
+    # contending inside one chip budget — goodput + preemption survival
+    try:
+        batch = _bench_batch_contention()
+    except SystemExit as e:
+        batch = {"error": str(e)}
+    except Exception as e:
+        batch = {"error": repr(e)[:300]}
+
     out_slo = {
         "slo_readiness_compliance": slo_section.get("compliance"),
         "canary_probe": slo_section.get("canary"),
@@ -1180,6 +1415,7 @@ def bench_control_plane():
     return {
         "slice_repair": slice_repair,
         "suspend_resume": suspend_resume,
+        "batch": batch,
         **out_slo,
         "cr_to_mesh_ready_p50_s": round(statistics.median(latencies.values()), 4),
         # where the time goes: per-phase p50 from the connected readiness

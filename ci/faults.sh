@@ -99,4 +99,24 @@ echo "=== serving lane: INVCHECK=1 iteration ==="
 INVCHECK=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, incl. slice chaos + pool churn + serving) ==="
+# job lane (ISSUE 10): the gang-scheduled TPUJob machine under faults —
+# host preemption mid-Running (checkpoint-preempt-requeue, resume from the
+# acked step), the reclaimer taking a batch slice for an interactive
+# arrival, sebulba dual-gang admission atomicity, and the seeded mixed
+# bad-day soak asserting no job is ever silently stuck in Admitted/
+# Preempted — rerun under the stress loop + one RACECHECK=1 and one
+# INVCHECK=1 iteration (the job machine is INVCHECK-covered kind-aware via
+# analysis/machines.py)
+for i in $(seq 1 "$REPEAT"); do
+    echo "=== job lane: iteration $i/$REPEAT ==="
+    python -m pytest tests/test_job.py -q -m "job and not slow" \
+        -p no:cacheprovider -p no:randomly "$@"
+done
+echo "=== job lane: RACECHECK=1 iteration ==="
+RACECHECK=1 python -m pytest tests/test_job.py -q -m "job and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+echo "=== job lane: INVCHECK=1 iteration ==="
+INVCHECK=1 python -m pytest tests/test_job.py -q -m "job and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, incl. slice chaos + pool churn + serving + job) ==="

@@ -17,6 +17,12 @@
 # bucket) and the `serving-availability` ratio over
 # inference_requests_total{result} (serving/metrics.py — jax-free precisely
 # so this lint sees the families on the manager image).
+#
+# Since ISSUE 10 it covers the batch layer: the `job-completion` SLO's
+# good-vs-total ratio over tpu_jobs_total{result} plus the queue-wait/
+# completion histograms and the goodput gauge (runtime/jobmetrics.py —
+# jax-free for the same reason), so a renamed job family or a dead label
+# fails here, not in a dashboard.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

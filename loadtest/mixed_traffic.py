@@ -1,8 +1,9 @@
-"""Mixed-traffic loadtest lane (ISSUE 9): interactive notebook churn AND a
-steady serving request stream through ONE cluster, gated by the existing SLO
-engine — pass/fail is burn rate and firing alerts, never ad-hoc thresholds.
+"""Mixed-traffic loadtest lane (ISSUE 9, three-class since ISSUE 10):
+interactive notebook churn AND a steady serving request stream AND a batch
+TPUJob stream through ONE cluster, gated by the existing SLO engine —
+pass/fail is burn rate and firing alerts, never ad-hoc thresholds.
 
-Two workload classes contend for the same chips:
+Three workload classes contend for the same chips:
 
 - **interactive churn**: N TPU notebooks cycling stop→checkpoint→suspend→
   warm-pool-resume (the ISSUE 7 machinery) for the whole run, feeding the
@@ -10,13 +11,16 @@ Two workload classes contend for the same chips:
 - **serving stream**: an InferenceEndpoint held Serving on its own slice
   while a real continuous-batching engine (serving/engine.py, tiny model on
   the driver CPU) takes a steady request stream joined to the endpoint's
-  trace, feeding the `token-latency` and `serving-availability` SLOs.
+  trace, feeding the `token-latency` and `serving-availability` SLOs;
+- **batch stream**: back-to-back TPUJobs (gang admission through the same
+  scheduler/slicepool, checkpoint cadence, step-acked completion) feeding
+  the `job-completion` SLO and the queue-wait/goodput series.
 
 The verdict is read back from the judgement layer itself: after the run the
 SLO engine's statuses must show every gated SLO at-or-above objective over
 the longest (scaled) window and the alert manager must hold zero firing
-alerts. A saturated queue, a wedged resume, or a degraded decode path fails
-here exactly the way it would page on-call.
+alerts. A saturated queue, a wedged resume, a stuck job, or a degraded
+decode path fails here exactly the way it would page on-call.
 
   python loadtest/mixed_traffic.py --notebooks 3 --duration 20 --qps 20
 """
@@ -32,7 +36,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-GATED_SLOS = ("token-latency", "serving-availability", "resume-latency")
+GATED_SLOS = ("token-latency", "serving-availability", "resume-latency",
+              "job-completion")
 
 
 def run(args) -> None:
@@ -52,13 +57,30 @@ def run(args) -> None:
     from odh_kubeflow_tpu.probe import sim_agent_behavior
     from odh_kubeflow_tpu.serving.engine import QueueFull, ServingEngine
 
+    from odh_kubeflow_tpu.api.job import TPUJob
+
     ns = args.namespace
     cluster = SimCluster().start()
-    # one slice per notebook + one for the endpoint: churn contends, the
-    # endpoint's slice stays pinned
-    cluster.add_tpu_pool("mixed", "v5e", "2x2", slices=args.notebooks + 1)
+    # one slice per notebook + one for the endpoint + one per batch
+    # stream: churn contends, the endpoint's slice stays pinned, jobs cycle
+    cluster.add_tpu_pool("mixed", "v5e", "2x2",
+                         slices=args.notebooks + 1 + max(1, args.jobs))
     agents = {}
     cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+
+    # the batch workload's step counter lives at the transport: every
+    # learner-gang /tpu/checkpoint ack advances it (the job controller's
+    # cadence window is the only caller)
+    job_steps = {}
+
+    def http_get(url, timeout=10.0):
+        if "/tpu/checkpoint" in url and "-learner-" in url:
+            name = url.split("//", 1)[1].split("-learner-", 1)[0]
+            job_steps[name] = job_steps.get(name, 0) + 30
+            return 200, json.dumps(
+                {"saved": True, "step": job_steps[name]}
+            ).encode()
+        return cluster.http_get(url, timeout=timeout)
     config = Config(
         enable_culling=False,
         suspend_enabled=True,
@@ -78,8 +100,10 @@ def run(args) -> None:
         # pages on noise no real deployment would see
         slo_window_scale=max(1e-4, args.duration / 600.0),
         canary_period_s=0.0,
+        job_checkpoint_window_s=2.0,
+        job_requeue_backoff_s=0.2,
     )
-    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    mgr = build_manager(cluster.store, config, http_get=http_get)
     mgr.start()
 
     result = {"notebooks": args.notebooks, "duration_s": args.duration,
@@ -164,6 +188,52 @@ def run(args) -> None:
         streamer = threading.Thread(target=drive_stream, daemon=True)
         streamer.start()
 
+        # -- batch stream (ISSUE 10): back-to-back TPUJobs on the spare
+        # slice, each admitted through the gang scheduler and completed by
+        # step-acked cadence checkpoints --
+        batch = {"submitted": 0, "succeeded": 0, "failed": 0}
+        stop_jobs = threading.Event()
+
+        def drive_jobs(stream: int):
+            from odh_kubeflow_tpu.api.notebook import TPUSpec as _TPUSpec
+
+            i = 0
+            while not stop_jobs.is_set():
+                name = f"batch-{stream}-{i}"
+                job = TPUJob()
+                job.metadata.name = name
+                job.metadata.namespace = ns
+                job.spec.template.spec.containers = [
+                    Container(name=name, image="jax:1")
+                ]
+                job.spec.tpu = _TPUSpec(accelerator="v5e", topology="2x2")
+                job.spec.steps = 90
+                job.spec.checkpoint_period_s = 0.3
+                cluster.client.create(job)
+                batch["submitted"] += 1
+                deadline = time.monotonic() + 30
+                state = ""
+                while time.monotonic() < deadline and not stop_jobs.is_set():
+                    state = cluster.client.get(
+                        TPUJob, ns, name
+                    ).metadata.annotations.get(C.JOB_STATE_ANNOTATION, "")
+                    if state in ("succeeded", "failed"):
+                        break
+                    time.sleep(0.05)
+                if state == "succeeded":
+                    batch["succeeded"] += 1
+                elif state == "failed":
+                    batch["failed"] += 1
+                cluster.client.delete(TPUJob, ns, name)
+                i += 1
+
+        jobbers = [
+            threading.Thread(target=drive_jobs, args=(s,), daemon=True)
+            for s in range(max(0, args.jobs))
+        ]
+        for jobber in jobbers:
+            jobber.start()
+
         # -- interactive churn until the deadline --
         churn_cycles = 0
         deadline = time.monotonic() + args.duration
@@ -190,7 +260,11 @@ def run(args) -> None:
             churn_cycles += 1
 
         stop_stream.set()
+        stop_jobs.set()
         streamer.join(timeout=5)
+        for jobber in jobbers:
+            if jobber.is_alive():
+                jobber.join(timeout=10)
         engine.stop(drain_timeout_s=10.0)
 
         # -- the verdict comes from the judgement layer --
@@ -228,6 +302,9 @@ def run(args) -> None:
         ok = ok and not firing
         result.update({
             "churn_cycles": churn_cycles,
+            "jobs_submitted": batch["submitted"],
+            "jobs_succeeded": batch["succeeded"],
+            "jobs_failed": batch["failed"],
             "requests_submitted": stream["submitted"],
             "requests_rejected": stream["rejected"],
             "requests_ok": sum(
@@ -249,6 +326,8 @@ def run(args) -> None:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--notebooks", type=int, default=3)
+    ap.add_argument("--jobs", type=int, default=1,
+                    help="concurrent batch TPUJob streams (0 disables)")
     ap.add_argument("--duration", type=float, default=20.0)
     ap.add_argument("--qps", type=float, default=20.0)
     ap.add_argument("--namespace", default="mixed")

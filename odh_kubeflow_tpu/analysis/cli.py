@@ -163,21 +163,24 @@ def _explore() -> int:
     import logging
 
     logging.disable(logging.CRITICAL)
-    from .explore import explore_default
+    from .explore import explore_default, explore_jobs
 
-    result = explore_default()
-    print(
-        f"explorer: {result.schedules} quiesced schedules, "
-        f"{result.visited} scheduler states ({result.pruned} pruned), "
-        f"truncated={result.truncated}, exhausted={result.exhausted}"
-    )
-    for v in result.violations:
-        print(f"  VIOLATION [{v.invariant}] {v.detail}")
-        print(f"    trace: {' -> '.join(v.trace)}")
-    if not result.ok:
+    ok = True
+    for name, run in (("default", explore_default), ("jobs", explore_jobs)):
+        result = run()
+        print(
+            f"explorer[{name}]: {result.schedules} quiesced schedules, "
+            f"{result.visited} scheduler states ({result.pruned} pruned), "
+            f"truncated={result.truncated}, exhausted={result.exhausted}"
+        )
+        for v in result.violations:
+            print(f"  VIOLATION [{v.invariant}] {v.detail}")
+            print(f"    trace: {' -> '.join(v.trace)}")
+        ok = ok and result.ok
+    if not ok:
         print("explorer FAILED: interleaving space not clean/exhausted")
         return 1
-    print("explorer OK: zero invariant violations over the explored space")
+    print("explorer OK: zero invariant violations over the explored spaces")
     return 0
 
 

@@ -99,6 +99,14 @@ EXPLORE_CONFIG = Config(
     repair_max_attempts=1000,
     repair_backoff_s=36000.0,
     repair_backoff_max_s=36000.0,
+    # job machine (ISSUE 10): generous checkpoint window (acks instant, so
+    # windows close by all-acked), cadence never fires on wall clock (the
+    # preempt op is the only checkpoint trigger), requeue backoff off,
+    # bind timeout far past the run (a threshold that can lapse mid-
+    # exploration makes schedules irreproducible)
+    job_checkpoint_window_s=600.0,
+    job_requeue_backoff_s=0.0,
+    job_admission_timeout_s=36000.0,
 )
 
 
@@ -573,6 +581,143 @@ class World:
         return digest
 
 
+class JobWorld(World):
+    """World + the third workload class (ISSUE 10): a batch TPUJob whose
+    admission warm-claims the suspended nb2's slice, so nb2's resume is a
+    pool miss that pressures the reclaimer into the job — the full
+    job-vs-suspend-vs-reclaim interleaving space (warm-claim admission,
+    checkpoint-before-preempt, requeue, re-admission) driven through the
+    REAL TPUJobReconciler and the REAL reclaimer.
+
+    `churn_ops` adds the base world's cull/suspend actors for nb1 on top —
+    the full three-actor churn space (the slow tier; the tight default
+    keeps nb1 as static occupancy so the tier-1 run exhausts in seconds)."""
+
+    def __init__(self, churn_ops: bool = False, **kw):
+        super().__init__(**kw)
+        from ..controllers.job import TPUJobReconciler
+
+        self.churn_ops = churn_ops
+        self.job = TPUJobReconciler(
+            self.manager, EXPLORE_CONFIG, http_get=fake_http_get
+        )
+        self._add_job("job1")
+
+    def _add_job(self, name: str) -> None:
+        from ..api.job import TPUJob
+
+        job = TPUJob()
+        job.metadata.name = name
+        job.metadata.namespace = NS
+        job.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+        job.spec.template.spec.containers = [
+            Container(name=name, image="jax:1")
+        ]
+        job.spec.steps = 1000  # never completes inside a run (step acks 100)
+        job.spec.checkpoint_period_s = 36000.0  # cadence never fires
+        self.client.create(job)
+        self.monitor.reset()  # premise, not a transition
+
+    def job_cluster_step(self, name: str) -> None:
+        """The cluster model's job half: one learner-gang pod keyed by the
+        job state annotation, honoring warm/claimed pool reservations under
+        the job's OWN claim key."""
+        from ..api.job import TPUJob
+
+        try:
+            job = self.client.get(TPUJob, NS, name)
+        except NotFoundError:
+            return
+        state = job.metadata.annotations.get(C.JOB_STATE_ANNOTATION, "")
+        desired = 1 if state in ("admitted", "running", "checkpointing") \
+            else 0
+        pods = [
+            p for p in self.client.list(
+                Pod, namespace=NS, labels={C.JOB_NAME_LABEL: name}
+            )
+            if not p.metadata.deletion_timestamp
+        ]
+        if desired == 0:
+            for p in pods:
+                self.client.delete(Pod, NS, p.metadata.name)
+            return
+        job_key = f"{NS}/{name}"
+        if not pods:
+            pod = Pod()
+            pod.metadata.name = f"{name}-{C.JOB_GANG_LEARNER}-0"
+            pod.metadata.namespace = NS
+            pod.metadata.labels[C.JOB_NAME_LABEL] = name
+            pod.metadata.labels[C.JOB_GANG_LABEL] = C.JOB_GANG_LEARNER
+            for node in sorted(self.client.list(Node),
+                               key=lambda n: n.metadata.name):
+                if self._node_free_for(node, job_key):
+                    pod.spec.node_name = node.metadata.name
+                    break
+            self.client.create(pod)
+            if pod.spec.node_name:
+                placed = self.client.get(Pod, NS, pod.metadata.name)
+                placed.status.phase = "Running"
+                placed.status.conditions = [
+                    Condition(type="Ready", status="True")
+                ]
+                self.client.update_status(placed)
+            return
+        for p in pods:
+            if p.spec.node_name:
+                continue
+            for node in sorted(self.client.list(Node),
+                               key=lambda n: n.metadata.name):
+                if self._node_free_for(node, job_key):
+                    p.spec.node_name = node.metadata.name
+                    p = self.client.update(p)
+                    p.status.phase = "Running"
+                    p.status.conditions = [
+                        Condition(type="Ready", status="True")
+                    ]
+                    self.client.update_status(p)
+                    break
+
+    def ops(self) -> List[Op]:
+        def reconcile(ctrl, name):
+            return lambda w: ctrl.reconcile(Request(namespace=NS, name=name))
+
+        # the job space drops the repair/fault/rival ops (that cross product
+        # is the base World's territory) and adds the job actor: the
+        # reclaimer preempt rides the REAL suspend-2 reconcile once nb2's
+        # resume finds its warm slice claimed away by the job's admission
+        ops = [
+            Op("suspend-2", reconcile(self.suspend, "nb2")),
+            Op("job-1", reconcile(self.job, "job1")),
+            Op("cluster", lambda w: (w.cluster_step("nb1"),
+                                     w.cluster_step("nb2"),
+                                     w.job_cluster_step("job1"))),
+            Op("unstop-2", lambda w: w.unstop("nb2"), once=True),
+        ]
+        if self.churn_ops:
+            ops[0:0] = [
+                Op("cull-1", reconcile(self.culler, "nb1")),
+                Op("suspend-1", reconcile(self.suspend, "nb1")),
+            ]
+        return ops
+
+    def snapshot(self) -> dict:
+        snap = super().snapshot()
+        snap["job"] = copy.deepcopy({"acked": self.job._ckpt_acked})
+        return snap
+
+    def restore_snapshot(self, snap: dict) -> None:
+        super().restore_snapshot(snap)
+        self.job._ckpt_acked = copy.deepcopy(snap["job"])["acked"]
+
+    def scratch_token(self) -> Tuple:
+        return super().scratch_token() + (
+            tuple(sorted(
+                (k, tuple(sorted(v.items())))
+                for k, v in self.job._ckpt_acked.items()
+            )),
+        )
+
+
 # ---------------------------------------------------------------------------
 # steady-state (quiescence) contracts
 # ---------------------------------------------------------------------------
@@ -587,8 +732,24 @@ def steady_violations(world: World) -> List[invcheck.InvariantViolation]:
     def v(name: str, detail: str) -> None:
         out.append(invcheck.InvariantViolation(name, detail))
 
+    from ..api.job import TPUJob
+
     notebooks = world.client.list(Notebook, namespace=NS)
+    jobs = world.client.list(TPUJob, namespace=NS)
     keys = {f"{nb.metadata.namespace}/{nb.metadata.name}" for nb in notebooks}
+    keys |= {f"{j.metadata.namespace}/{j.metadata.name}" for j in jobs}
+    for j in jobs:
+        jkey = f"{NS}/{j.metadata.name}"
+        jstate = j.metadata.annotations.get(C.JOB_STATE_ANNOTATION, "")
+        # legitimate parks: queued Pending (""), a long Running stretch
+        # (cadence is wall-clock), and the terminal states. Admitted /
+        # Checkpointing / Preempted must always advance — an actor out of
+        # work with a job wedged there is exactly the silent-stuck bug the
+        # requeue contract exists to prevent.
+        if jstate not in ("", "running", "succeeded", "failed"):
+            v("stuck-state",
+              f"{jkey} quiesced in non-parked job state {jstate!r} — every "
+              "actor is out of work and nothing will ever advance it")
     for nb in notebooks:
         ann = nb.metadata.annotations
         key = f"{NS}/{nb.metadata.name}"
@@ -854,6 +1015,21 @@ def explore_default(max_preemptions: int = 0, seed: int = 0,
     (~40 s); 1 adds an arbitrary preemptive switch anywhere (~3 min, the
     slow-marked soak tier)."""
     return Explorer(World, max_preemptions=max_preemptions, seed=seed,
+                    max_visited=max_visited).explore()
+
+
+def explore_jobs(max_preemptions: int = 0, seed: int = 0,
+                 max_visited: int = 200_000,
+                 churn_ops: bool = False) -> ExplorationResult:
+    """ISSUE 10 acceptance: bounded-exhaustive over the job-vs-suspend-vs-
+    reclaim interleaving space (JobWorld: warm-claim admission steals the
+    suspended notebook's slice, the resume pressures the REAL reclaimer
+    into checkpoint-preempting the REAL job controller, the job requeues) —
+    must come back exhausted, un-truncated, and violation-free. The default
+    space exhausts in seconds; churn_ops=True adds the interactive
+    cull/suspend actors (the slow tier, ~2 min)."""
+    return Explorer(lambda: JobWorld(churn_ops=churn_ops),
+                    max_preemptions=max_preemptions, seed=seed,
                     max_visited=max_visited).explore()
 
 

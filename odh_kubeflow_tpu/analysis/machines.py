@@ -310,6 +310,89 @@ INFERENCE_MACHINE = MachineSpec(
 )
 
 # ---------------------------------------------------------------------------
+# gang-scheduled batch/RL job (controllers/job.py, ISSUE 10)
+# ---------------------------------------------------------------------------
+
+JOB_MACHINE = MachineSpec(
+    name="job",
+    annotation="JOB_STATE_ANNOTATION",
+    owner="job.py",
+    kind="TPUJob",
+    doc="Gang-scheduled batch/RL jobs (Podracer anakin/sebulba layouts): "
+        "all-or-nothing gang admission through the scheduler/slicepool "
+        "(warm-claim first; sebulba secures BOTH gangs atomically or "
+        "neither), checkpoint-before-preempt when the reclaimer or a host "
+        "preemption takes the slice, and a Preempted job requeues to resume "
+        "from the saved step — it loses only progress since the last "
+        "checkpoint.",
+    states=(
+        State("", "Pending",
+              "not admitted; gang capacity being secured (queued-over-"
+              "budget jobs wait here with a QueuedOverBudget condition)"),
+        State("admitted", "Admitted",
+              "gangs secured (warm claims bound or free capacity found) and "
+              "the workload created; waiting for every host of every gang "
+              "to come ready"),
+        State("running", "Running",
+              "all gangs ready; steps progressing (the workload reports "
+              "progress through checkpoint acks)"),
+        State("checkpointing", "Checkpointing",
+              "cadence or preempt: the learner gang's /tpu/checkpoint hooks "
+              "are driven inside a bounded window and the acked step is "
+              "recorded; never a reclaim victim mid-window"),
+        State("preempted", "Preempted",
+              "gang(s) scaled away, slice released (warm at the JOB's "
+              "priority unless reclaim-forced); requeues to Pending to "
+              "resume from the saved step"),
+        State("succeeded", "Succeeded",
+              "acked step reached the budget; replicas 0, slice released",
+              terminal=True, self_healing=True),
+        State("failed", "Failed",
+              "backoffLimit or maxRuntime exhausted",
+              terminal=True, self_healing=True, incident=True),
+    ),
+    transitions=(
+        Transition("", "admitted", "job.py:_run_pending",
+                   "gang capacity secured: warm claim(s) bound — sebulba "
+                   "claims BOTH gangs atomically or neither — or whole free "
+                   "slices found for every gang; workload created"),
+        Transition("admitted", "running", "job.py:_run_admitted",
+                   "every host of every gang ready; queue-wait observed and "
+                   "the job.ready root closes"),
+        Transition("admitted", "preempted", "job.py:_preempt",
+                   "preempt requested (or placement lost) before the run "
+                   "started: nothing to checkpoint, park and requeue"),
+        Transition("running", "checkpointing", "job.py:_run_running",
+                   "checkpoint cadence due, or preempt requested: save "
+                   "before anything moves"),
+        Transition("checkpointing", "running", "job.py:_complete_checkpoint",
+                   "acked (or window expired): cadence checkpoint, keep "
+                   "running"),
+        Transition("checkpointing", "succeeded",
+                   "job.py:_complete_checkpoint",
+                   "acked step reached steps x completions: done"),
+        Transition("checkpointing", "preempted", "job.py:_preempt",
+                   "preempt requested: state saved (_complete_checkpoint "
+                   "banked the ack), park and requeue"),
+        Transition("running", "preempted", "job.py:_preempt",
+                   "host preemption / readiness lost mid-run: park and "
+                   "requeue; progress since the last checkpoint is lost"),
+        Transition("running", "failed", "job.py:_fail",
+                   "backoffLimit exhausted or maxRuntime exceeded"),
+        Transition("preempted", "", "job.py:reconcile",
+                   "requeue: a fresh Pending episode resumes from the "
+                   "saved step"),
+        Transition("succeeded", "", "job.py:reconcile",
+                   "user rerun (spec bump / annotation clear): a fresh "
+                   "episode"),
+        Transition("failed", "", "job.py:reconcile",
+                   "self-heal: user reset after the failure"),
+        Transition("*", "", "job.py:reconcile",
+                   "defensive clear of an unknown state value"),
+    ),
+)
+
+# ---------------------------------------------------------------------------
 # warm-pool node machine (cluster/slicepool.py) — NOT statically checked
 # (its annotations live on Nodes and their canonical home is slicepool.py);
 # declared here so the INVCHECK monitor and the explorer validate observed
@@ -351,10 +434,11 @@ POOL_MACHINE = MachineSpec(
 )
 
 # the statically-checked machines (ISSUE 8 contract + ISSUE 9's inference
-# machine, covered by the conformance checker and explorer from day one) +
-# the runtime-only pool machine
+# machine + ISSUE 10's job machine, covered by the conformance checker and
+# explorer from day one) + the runtime-only pool machine
 MACHINES: Tuple[MachineSpec, ...] = (
     SUSPEND_MACHINE, REPAIR_MACHINE, CULLING_MACHINE, INFERENCE_MACHINE,
+    JOB_MACHINE,
 )
 ALL_MACHINES: Tuple[MachineSpec, ...] = MACHINES + (POOL_MACHINE,)
 

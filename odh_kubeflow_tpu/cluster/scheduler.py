@@ -34,6 +34,33 @@ from ..tpu import GKE_NODEPOOL_LABEL, TPU_RESOURCE
 from ..utils import parse_quantity
 from .store import DELETED
 
+def claim_owner_labels() -> tuple:
+    """The claim-owner table: pod labels that name the workload owning a
+    claimed slice pool, in precedence order. Three workload classes share
+    the pool's claim namespace (ns/name keys), so a FOURTH class joins by
+    adding its label here — not by growing another special case inline in
+    the scheduler (ISSUE 10 satellite: `_pod_owner` used to if/else
+    notebook and inference-endpoint owners by hand)."""
+    from ..controllers.constants import (
+        INFERENCE_NAME_LABEL,
+        JOB_NAME_LABEL,
+        NOTEBOOK_NAME_LABEL,
+    )
+
+    return (NOTEBOOK_NAME_LABEL, INFERENCE_NAME_LABEL, JOB_NAME_LABEL)
+
+
+def pod_claim_owner(pod: Pod) -> str:
+    """ns/name of the workload that owns this pod — what a claimed pool's
+    `pool-claimed-by` must equal for the bind to be allowed; "" for an
+    owner-less pod (which must never slip through the warm sentinel)."""
+    for label in claim_owner_labels():
+        owner = pod.metadata.labels.get(label, "")
+        if owner:
+            return f"{pod.metadata.namespace}/{owner}"
+    return ""
+
+
 def pod_tpu_request(pod: Pod) -> int:
     total = 0
     for c in pod.spec.containers:
@@ -241,20 +268,13 @@ class Scheduler:
 
     @staticmethod
     def _pod_owner(pod: Pod) -> str:
-        """ns/name of the workload that owns this pod — what a claimed
-        pool's `pool-claimed-by` must equal for the bind to be allowed.
-        Notebooks and InferenceEndpoints share the claim namespace: a
-        promoted endpoint claims its source notebook's released slice under
-        its OWN key, and only its pods may land there (ISSUE 9)."""
-        from ..controllers.constants import (
-            INFERENCE_NAME_LABEL,
-            NOTEBOOK_NAME_LABEL,
-        )
-
-        owner = pod.metadata.labels.get(
-            NOTEBOOK_NAME_LABEL, ""
-        ) or pod.metadata.labels.get(INFERENCE_NAME_LABEL, "")
-        return f"{pod.metadata.namespace}/{owner}" if owner else ""
+        """Delegates to the shared claim-owner table (pod_claim_owner):
+        notebooks, InferenceEndpoints, and TPUJobs share the claim
+        namespace — a promoted endpoint claims its source notebook's
+        released slice under its OWN key (ISSUE 9), a batch job warm-claims
+        a suspended notebook's slice the same way (ISSUE 10) — and only the
+        claimant's pods may land there."""
+        return pod_claim_owner(pod)
 
     @staticmethod
     def _pool_reservation(pool_nodes: List[Node]) -> Optional[str]:

@@ -4,6 +4,7 @@ from .metrics import NotebookMetrics
 from .notebook import EventMirrorController, NotebookReconciler, hosts_service_name
 from .culling import CullingReconciler
 from .inference import InferenceEndpointReconciler
+from .job import TPUJobReconciler
 from .probe_status import ProbeStatusController
 from .slice_repair import SliceRepairController
 from .suspend import SuspendResumeController

@@ -89,6 +89,16 @@ class Config:
     # per-endpoint via spec.serving.drainTimeoutS)
     serving_loading_window_s: float = 30.0
     serving_drain_timeout_s: float = 5.0
+    # batch/RL jobs (controllers/job.py): the bounded window a cadence or
+    # preempt checkpoint gets before the job moves on, the requeue
+    # backoff a preempted job waits before re-admitting (an instant
+    # re-admission would race the very requester its slice was reclaimed
+    # for), and the bind timeout after which an Admitted job whose gangs
+    # never all came ready parks and requeues instead of wedging (a
+    # claimed slice can die under the gang mid-bind)
+    job_checkpoint_window_s: float = 10.0
+    job_requeue_backoff_s: float = 2.0
+    job_admission_timeout_s: float = 120.0
     # SLO engine + alerting (runtime/slo.py, runtime/alerts.py): window_scale
     # shrinks the canonical 5m/30m/1h/6h burn windows (soaks/tests run the
     # real rule shapes in seconds); eval period 0 derives from the scale
@@ -193,6 +203,21 @@ class Config:
         if os.environ.get("SERVING_DRAIN_TIMEOUT_S"):
             c.serving_drain_timeout_s = max(
                 0.0, float(os.environ["SERVING_DRAIN_TIMEOUT_S"])
+            )
+        if os.environ.get("JOB_CHECKPOINT_WINDOW_S"):
+            # clamp: a zero window would abandon every save before the first
+            # checkpoint probe ever ran
+            c.job_checkpoint_window_s = max(
+                0.1, float(os.environ["JOB_CHECKPOINT_WINDOW_S"])
+            )
+        if os.environ.get("JOB_REQUEUE_BACKOFF_S"):
+            c.job_requeue_backoff_s = max(
+                0.0, float(os.environ["JOB_REQUEUE_BACKOFF_S"])
+            )
+        if os.environ.get("JOB_ADMISSION_TIMEOUT_S"):
+            # 0 disables the bind timeout entirely
+            c.job_admission_timeout_s = max(
+                0.0, float(os.environ["JOB_ADMISSION_TIMEOUT_S"])
             )
         c.slo_enabled = _env_bool("SLO_ENABLED", c.slo_enabled)
         if os.environ.get("SLO_WINDOW_SCALE"):

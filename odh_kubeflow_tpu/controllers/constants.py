@@ -119,6 +119,62 @@ INFERENCE_NAME_LABEL = "inference-endpoint-name"
 # as this value, so an idle notebook is always suspended before live traffic
 ENDPOINT_DEFAULT_PRIORITY = 10
 
+# -- batch/RL jobs (controllers/job.py, ISSUE 10) --
+# The gang-scheduled job state machine, annotation-durable like the
+# suspend/repair/inference machines above (declared as data in
+# analysis/machines.py):
+#   Pending ("") -> Admitted (gangs secured: warm claim(s) or free capacity;
+#                   sebulba claims BOTH gangs atomically or neither)
+#             -> Running (every host of every gang ready; steps progress)
+#             -> Checkpointing (cadence or preempt: /tpu/checkpoint driven,
+#                acked step recorded) -> Running | Succeeded | Preempted
+#   Running --host loss--> Preempted --requeue--> Pending (resume from the
+#   saved step); Failed (backoffLimit / maxRuntime) is terminal + incident
+JOB_STATE_ANNOTATION = "jobs.tpu.kubeflow.org/job-state"
+# last ACKED checkpoint step — the durable resume point; survives requeues
+JOB_CHECKPOINT_STEP_ANNOTATION = "jobs.tpu.kubeflow.org/checkpoint-step"
+JOB_CHECKPOINT_DEADLINE_ANNOTATION = (
+    "jobs.tpu.kubeflow.org/checkpoint-deadline"
+)
+# stamped by the oversubscription reclaimer ("capacity-pressure:<ns/name>")
+# or an operator ("user"): the job controller answers with
+# checkpoint-before-preempt; capacity-pressure preempts release the slice to
+# general capacity (the requester needs the chips), anything else parks warm
+JOB_PREEMPT_ANNOTATION = "jobs.tpu.kubeflow.org/preempt-requested"
+JOB_QUEUED_AT_ANNOTATION = "jobs.tpu.kubeflow.org/queued-at"  # first submit
+# current episode's queue entry (reset per requeue; feeds the queue-wait
+# histogram episode by episode)
+JOB_EPISODE_QUEUED_AT_ANNOTATION = "jobs.tpu.kubeflow.org/episode-queued-at"
+JOB_ADMITTED_AT_ANNOTATION = "jobs.tpu.kubeflow.org/admitted-at"
+# first admission EVER (survives requeues, reset only on terminal rerun):
+# the spec.maxRuntimeS clock starts here — queue wait before the first
+# admission is free, parked/requeued time after it is not
+JOB_FIRST_ADMITTED_AT_ANNOTATION = "jobs.tpu.kubeflow.org/first-admitted-at"
+# the checkpoint step this EPISODE resumed from, pinned at admission: the
+# pod template's TPU_JOB_RESUME_STEP reads this, never the live
+# checkpoint-step — a cadence save mid-run must not mutate the template
+# and roll the very gang it just checkpointed
+JOB_RESUME_STEP_ANNOTATION = "jobs.tpu.kubeflow.org/resume-step"
+JOB_RUN_STARTED_AT_ANNOTATION = "jobs.tpu.kubeflow.org/run-started"
+# productive seconds banked at checkpoint acks (progress that SURVIVES a
+# preemption); the job_goodput_ratio numerator
+JOB_RUN_SECONDS_ANNOTATION = "jobs.tpu.kubeflow.org/run-seconds"
+JOB_PREEMPTIONS_ANNOTATION = "jobs.tpu.kubeflow.org/preemptions"
+JOB_FAILURES_ANNOTATION = "jobs.tpu.kubeflow.org/failures"
+# pod -> owning TPUJob (the batch analog of notebook-name: the scheduler's
+# claimed-pool owner check and the sim probe agent both key on it) + which
+# gang of a sebulba job the pod belongs to
+JOB_NAME_LABEL = "tpu-job-name"
+JOB_GANG_LABEL = "tpu-job-gang"
+JOB_GANG_LEARNER = "learner"
+JOB_GANG_ACTORS = "actors"
+# batch defaults BELOW interactive notebooks in the reclaim ordering: an
+# unset spec.tpu.priority on a job reads as this value, so contention
+# suspends a batch job before it ever touches a notebook or an endpoint
+JOB_DEFAULT_PRIORITY = -10
+# status condition set while a job queues over the chip budget
+JOB_QUEUED_CONDITION = "QueuedOverBudget"
+
 # -- checkpoint restore verification (ISSUE 9 satellite) --
 # checksum of the state the checkpoint hook saved (probe agent ack); after
 # resume — and after endpoint Loading — the /tpu/restore probe's checksum is

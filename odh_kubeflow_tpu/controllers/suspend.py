@@ -53,6 +53,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..api.core import Pod, emit_deduped_event
 from ..api.inference import InferenceEndpoint
+from ..api.job import TPUJob
 from ..api.notebook import Notebook
 from ..apimachinery import (
     NotFoundError,
@@ -82,6 +83,13 @@ from .inference import (
     STATE_TERMINATED as EP_STATE_TERMINATED,
     endpoint_priority,
 )
+from .job import (
+    STATE_ADMITTED as JOB_STATE_ADMITTED,
+    STATE_CHECKPOINTING as JOB_STATE_CHECKPOINTING,
+    STATE_RUNNING as JOB_STATE_RUNNING,
+    job_gangs,
+    job_priority,
+)
 from .notebook import per_ordinal_probe_urls
 
 log = logging.getLogger(__name__)
@@ -102,6 +110,86 @@ def notebook_priority(nb: Notebook) -> int:
         return int(nb.spec.tpu.priority)
     except (TypeError, ValueError):
         return 0
+
+
+def admitted_chip_demand(client, exclude_job: str = "") -> int:
+    """Total admitted chip demand across ALL THREE workload classes —
+    notebooks (active + suspended), non-Terminated endpoints, and ADMITTED
+    jobs (Admitted/Running/Checkpointing; Pending and Preempted jobs
+    re-pass the job controller's own budget gate at (re)admission before
+    their demand stands, so a queue of never-admitted jobs cannot block
+    notebook reclaim). The ONE budget math the reclaimer's gate and the
+    job controller's queued-over-budget admission share; `exclude_job`
+    (ns/name) lets the job controller count its own gangs exactly once."""
+    total = 0
+    for cand in client.list(Notebook):
+        if cand.spec.tpu is None or not cand.spec.tpu.accelerator:
+            continue
+        if cand.metadata.deletion_timestamp:
+            continue
+        try:
+            total += plan_slice(
+                cand.spec.tpu.accelerator,
+                cand.spec.tpu.topology,
+                cand.spec.tpu.chips,
+            ).chips
+        except Exception as e:
+            # a junk spec must not crash the budget math, but it must be
+            # visible — an unplannable notebook holds zero budget
+            log.debug(
+                "budget math: skipping unplannable %s/%s: %s",
+                cand.metadata.namespace, cand.metadata.name, e,
+            )
+            continue
+    # the second workload class holds budget too: an admitted endpoint
+    # is chip demand exactly like a notebook (Terminated ones released
+    # their slice and dropped out of the demand picture)
+    from .inference import resolve_endpoint_tpu
+
+    for ep in client.list(InferenceEndpoint):
+        if ep.metadata.deletion_timestamp:
+            continue
+        if (
+            ep.metadata.annotations.get(C.INFERENCE_STATE_ANNOTATION)
+            == EP_STATE_TERMINATED
+        ):
+            continue
+        tpu = resolve_endpoint_tpu(client, ep)
+        if tpu is None:
+            continue
+        try:
+            total += plan_slice(
+                tpu.accelerator, tpu.topology, tpu.chips
+            ).chips
+        except Exception as e:
+            log.debug(
+                "budget math: skipping unplannable endpoint %s/%s: %s",
+                ep.metadata.namespace, ep.metadata.name, e,
+            )
+            continue
+    # ...and the third: every gang of every ADMITTED job. Pending and
+    # Preempted jobs pass through the job controller's own budget gate
+    # (again, at requeue) before their demand stands — counting them here
+    # would let a queue of never-admitted jobs block notebook reclaim.
+    for job in client.list(TPUJob):
+        if job.metadata.deletion_timestamp:
+            continue
+        key = f"{job.metadata.namespace}/{job.metadata.name}"
+        if exclude_job and key == exclude_job:
+            continue
+        if job.metadata.annotations.get(C.JOB_STATE_ANNOTATION, "") not in (
+            JOB_STATE_ADMITTED, JOB_STATE_RUNNING, JOB_STATE_CHECKPOINTING,
+        ):
+            continue
+        try:
+            total += sum(shape.chips for _, shape in job_gangs(job))
+        except Exception as e:
+            log.debug(
+                "budget math: skipping unplannable job %s/%s: %s",
+                job.metadata.namespace, job.metadata.name, e,
+            )
+            continue
+    return total
 
 
 class SuspendResumeController:
@@ -757,6 +845,15 @@ class SuspendResumeController:
             )
             if still_draining:
                 return Result(requeue_after=0.2)
+        for jc in self.client.list(TPUJob):
+            # a job we already victimized is mid checkpoint-preempt-requeue:
+            # its preempt stamp survives until the requeue clears it, so the
+            # guard holds exactly as long as the slice is still coming free
+            if (
+                jc.metadata.annotations.get(C.JOB_PREEMPT_ANNOTATION)
+                == f"capacity-pressure:{req.key}"
+            ):
+                return Result(requeue_after=0.2)
 
         budget = self.config.chip_budget
         if budget > 0 and self._admitted_chips() > budget:
@@ -805,13 +902,62 @@ class SuspendResumeController:
             return Result(requeue_after=0.2)
         victim = self._pick_suspend_victim(nb, shape)
         ep_victim = self._pick_endpoint_victim(nb, shape)
-        if victim is not None and ep_victim is not None:
-            # strictly-lower priority loses; notebooks break ties (an
-            # endpoint only drains when it is UNAMBIGUOUSLY the cheapest)
-            if endpoint_priority(ep_victim) < notebook_priority(victim):
-                victim = None
-            else:
-                ep_victim = None
+        job_victim = self._pick_job_victim(nb, shape)
+        # ONE ordering across all three classes: the strictly-lowest
+        # priority loses; ties drain batch first (most preemptible — a job
+        # requeues and resumes from its checkpoint), then suspend the
+        # notebook, and an endpoint only when UNAMBIGUOUSLY the cheapest
+        ranked = []
+        if job_victim is not None:
+            ranked.append((job_priority(job_victim), 0, "job"))
+        if victim is not None:
+            ranked.append((notebook_priority(victim), 1, "nb"))
+        if ep_victim is not None:
+            ranked.append((endpoint_priority(ep_victim), 2, "ep"))
+        winner = min(ranked)[2] if ranked else None
+        if winner != "nb":
+            victim = None
+        if winner != "ep":
+            ep_victim = None
+        if winner != "job":
+            job_victim = None
+        if job_victim is not None:
+            self._victim_cooldown[req.key] = now
+            jkey = f"{job_victim.metadata.namespace}/{job_victim.metadata.name}"
+            self._patch_job_victim(
+                job_victim,
+                {C.JOB_PREEMPT_ANNOTATION: f"capacity-pressure:{req.key}"},
+            )
+            notebook_reclaims_total.inc(reason="job-preempt")
+            self._emit_event(
+                nb, "SliceReclaimed",
+                f"preempting batch job {jkey} (priority "
+                f"{job_priority(job_victim)}) to free capacity for "
+                f"{req.key} (priority {notebook_priority(nb)}); the job "
+                "checkpoints before its slice moves and requeues to resume "
+                "from the saved step",
+                etype="Normal",
+            )
+            recorder.record(
+                "transition", machine="suspend", notebook=req.key,
+                state="reclaim", victim=jkey, reason="job-preempt",
+            )
+            recorder.snapshot(
+                "reclaim", subject=jkey, client=self.client,
+                notebooks=[(nb.metadata.namespace, nb.metadata.name)],
+                extra={
+                    "reason": "job-preempt",
+                    "requester": req.key,
+                    "requester_priority": notebook_priority(nb),
+                    "victim_priority": job_priority(job_victim),
+                },
+            )
+            log.warning(
+                "reclaim: preempting job %s (priority %d) for %s "
+                "(priority %d)", jkey, job_priority(job_victim),
+                req.key, notebook_priority(nb),
+            )
+            return Result(requeue_after=0.1)
         if ep_victim is not None:
             self._victim_cooldown[req.key] = now
             ekey = f"{ep_victim.metadata.namespace}/{ep_victim.metadata.name}"
@@ -994,6 +1140,66 @@ class SuspendResumeController:
         candidates.sort(key=lambda t: (t[0], t[1]))
         return candidates[0][2]
 
+    def _pick_job_victim(
+        self, requester: Notebook, shape
+    ) -> Optional[TPUJob]:
+        """Batch jobs are reclaim victims by `spec.tpu.priority` in the
+        same ordering as notebooks/endpoints — but they default BELOW
+        interactive (JOB_DEFAULT_PRIORITY), only a Running job is eligible
+        (its slice is confirmed live capacity), and a job mid-Checkpointing
+        is NEVER victimized (the Draining rule's mirror, ISSUE 10 bugfix
+        sweep): its save is exactly what makes the preemption survivable,
+        and a preempt stamp racing the window would re-enter it."""
+        my_priority = notebook_priority(requester)
+        candidates: List[Tuple[int, str, TPUJob]] = []
+        for cand in self.client.list(TPUJob):
+            if cand.metadata.deletion_timestamp:
+                continue
+            ann = cand.metadata.annotations
+            state = ann.get(C.JOB_STATE_ANNOTATION, "")
+            if state != JOB_STATE_RUNNING:
+                continue  # Pending/Admitted/Preempted free nothing usable;
+                # Checkpointing is explicitly protected mid-window
+            if C.JOB_PREEMPT_ANNOTATION in ann:
+                continue  # already on the way out
+            if cand.metadata.labels.get(C.TPU_RECLAIM_EXEMPT_LABEL):
+                continue
+            try:
+                gangs = job_gangs(cand)
+            except Exception as e:
+                log.debug("victim scan: unplannable job %s/%s: %s",
+                          cand.metadata.namespace, cand.metadata.name, e)
+                continue
+            if not any(
+                gshape.gke_accelerator == shape.gke_accelerator
+                and gshape.topology == shape.topology
+                for _, gshape in gangs
+            ):
+                continue  # no gang of this job frees the requested shape
+            pri = job_priority(cand)
+            if pri >= my_priority:
+                continue
+            key = f"{cand.metadata.namespace}/{cand.metadata.name}"
+            candidates.append((pri, key, cand))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda t: (t[0], t[1]))
+        return candidates[0][2]
+
+    def _patch_job_victim(self, victim: TPUJob, updates: dict) -> None:
+        def attempt():
+            return self.client.patch(
+                TPUJob,
+                victim.metadata.namespace,
+                victim.metadata.name,
+                {"metadata": {"annotations": updates}},
+            )
+
+        try:
+            retry_on_conflict(attempt)
+        except NotFoundError:
+            pass  # deleted mid-reclaim; pressure re-judges next pass
+
     def _patch_endpoint_victim(
         self, victim: InferenceEndpoint, updates: dict
     ) -> None:
@@ -1051,53 +1257,7 @@ class SuspendResumeController:
         return False
 
     def _admitted_chips(self) -> int:
-        total = 0
-        for cand in self.client.list(Notebook):
-            if cand.spec.tpu is None or not cand.spec.tpu.accelerator:
-                continue
-            if cand.metadata.deletion_timestamp:
-                continue
-            try:
-                total += plan_slice(
-                    cand.spec.tpu.accelerator,
-                    cand.spec.tpu.topology,
-                    cand.spec.tpu.chips,
-                ).chips
-            except Exception as e:
-                # a junk spec must not crash the budget math, but it must be
-                # visible — an unplannable notebook holds zero budget
-                log.debug(
-                    "budget math: skipping unplannable %s/%s: %s",
-                    cand.metadata.namespace, cand.metadata.name, e,
-                )
-                continue
-        # the second workload class holds budget too: an admitted endpoint
-        # is chip demand exactly like a notebook (Terminated ones released
-        # their slice and dropped out of the demand picture)
-        from .inference import resolve_endpoint_tpu
-
-        for ep in self.client.list(InferenceEndpoint):
-            if ep.metadata.deletion_timestamp:
-                continue
-            if (
-                ep.metadata.annotations.get(C.INFERENCE_STATE_ANNOTATION)
-                == EP_STATE_TERMINATED
-            ):
-                continue
-            tpu = resolve_endpoint_tpu(self.client, ep)
-            if tpu is None:
-                continue
-            try:
-                total += plan_slice(
-                    tpu.accelerator, tpu.topology, tpu.chips
-                ).chips
-            except Exception as e:
-                log.debug(
-                    "budget math: skipping unplannable endpoint %s/%s: %s",
-                    ep.metadata.namespace, ep.metadata.name, e,
-                )
-                continue
-        return total
+        return admitted_chip_demand(self.client)
 
     # ---------- helpers ----------
 

@@ -140,6 +140,68 @@ def notebook_crd(served_versions=None) -> Dict[str, Any]:
     }
 
 
+def tpu_job_crd() -> Dict[str, Any]:
+    """The TPUJob CustomResourceDefinition (ISSUE 10). One served version:
+    v1beta1 is both hub and storage — the batch surface is new, there are
+    no legacy spokes to convert."""
+    from ..api.job import TPUJob
+
+    spec_schema = schema_for_model(typing.get_type_hints(TPUJob)["spec"])
+    status_schema = schema_for_model(typing.get_type_hints(TPUJob)["status"])
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpujobs.kubeflow.org"},
+        "spec": {
+            "group": "kubeflow.org",
+            "names": {
+                "kind": "TPUJob",
+                "listKind": "TPUJobList",
+                "plural": "tpujobs",
+                "singular": "tpujob",
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": "v1beta1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": spec_schema,
+                                "status": status_schema,
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                    "additionalPrinterColumns": [
+                        {
+                            "name": "Phase",
+                            "type": "string",
+                            "jsonPath": ".status.phase",
+                        },
+                        {
+                            "name": "Steps",
+                            "type": "integer",
+                            "jsonPath": ".status.completedSteps",
+                        },
+                        {
+                            "name": "Preemptions",
+                            "type": "integer",
+                            "jsonPath": ".status.preemptions",
+                        },
+                    ],
+                }
+            ],
+        },
+    }
+
+
 def inference_endpoint_crd() -> Dict[str, Any]:
     """The InferenceEndpoint CustomResourceDefinition (ISSUE 9). One served
     version: v1beta1 is both hub and storage — the serving surface is new,

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from .crdgen import inference_endpoint_crd, notebook_crd
+from .crdgen import inference_endpoint_crd, notebook_crd, tpu_job_crd
 
 APP_LABELS = {"app.kubernetes.io/part-of": "tpu-notebook-controller"}
 
@@ -65,6 +65,9 @@ def cluster_role() -> Dict[str, Any]:
                 "inferenceendpoints",
                 "inferenceendpoints/status",
                 "inferenceendpoints/finalizers",
+                "tpujobs",
+                "tpujobs/status",
+                "tpujobs/finalizers",
             ],
             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
         },
@@ -313,6 +316,7 @@ def base_manifests(ns: str, image: str, auth_proxy_image: str) -> List[Dict[str,
         namespace(ns),
         notebook_crd(),
         inference_endpoint_crd(),
+        tpu_job_crd(),
         service_account(ns),
         cluster_role(),
         cluster_role_binding(ns),

@@ -21,6 +21,7 @@ from .controllers import (
     ProbeStatusController,
     SliceRepairController,
     SuspendResumeController,
+    TPUJobReconciler,
     TPUWorkbenchReconciler,
 )
 from .controllers.metrics import NotebookMetrics
@@ -61,6 +62,7 @@ def build_manager(
     SliceRepairController(mgr, config, http_get=http_get).setup()
     SuspendResumeController(mgr, config, http_get=http_get).setup()
     InferenceEndpointReconciler(mgr, config, http_get=http_get).setup()
+    TPUJobReconciler(mgr, config, http_get=http_get).setup()
     if config.pool_prewarm > 0:
         from .cluster.slicepool import PoolPrewarmer
         from .tpu import plan_slice

@@ -615,12 +615,14 @@ def sim_agent_behavior(agents: Dict[Any, "NotebookAgent"], duty: float = 0.9,
         return cold_start_s
 
     def behavior(pod):
-        # notebook pods AND serving-endpoint pods (ISSUE 9): both run the
-        # same in-pod agent; the endpoint's readiness gate and restore
-        # verification ride the identical /tpu/* surface
+        # notebook pods, serving-endpoint pods (ISSUE 9), AND batch-job
+        # pods (ISSUE 10): all three run the same in-pod agent; readiness
+        # gates and checkpoint/restore hooks ride the identical /tpu/*
+        # surface
         if not (
             pod.metadata.labels.get(C.NOTEBOOK_NAME_LABEL)
             or pod.metadata.labels.get(C.INFERENCE_NAME_LABEL)
+            or pod.metadata.labels.get(C.JOB_NAME_LABEL)
         ):
             return None
         # keyed per container incarnation: a crash-restarted container (same

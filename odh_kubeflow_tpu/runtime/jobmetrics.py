@@ -1,0 +1,71 @@
+"""Batch-job metric families (ISSUE 10) — the judgement surface of the
+gang-scheduled TPUJob class.
+
+Deliberately jax-free (the serving/metrics.py idiom): these register into
+the global registry at import so the SLO engine's `job-completion`
+objective and `ci/slo_lint.sh` see the families even on a manager image
+that never loads the workload libraries. The job controller
+(controllers/job.py) feeds them; the bench and the mixed loadtest read them
+only through the SLO machinery and the goodput gauge — pass/fail is burn
+rate, not ad-hoc thresholds.
+"""
+from __future__ import annotations
+
+import threading
+
+from .metrics import global_registry
+
+tpu_job_queue_wait_seconds = global_registry.histogram(
+    "tpu_job_queue_wait_seconds",
+    "Per-episode queue wait: job submit (or requeue) -> gang admission "
+    "(all slices secured, workload created)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+             1800.0),
+)
+tpu_job_completion_seconds = global_registry.histogram(
+    "tpu_job_completion_seconds",
+    "First submit -> Succeeded wallclock per job, every preempt-requeue "
+    "round trip included",
+    buckets=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0, 1800.0, 7200.0),
+)
+tpu_jobs_total = global_registry.counter(
+    "tpu_jobs_total",
+    "Jobs reaching a terminal state, by result (succeeded / failed) — the "
+    "job-completion SLO's good/total ratio",
+    labels=("result",),
+)
+tpu_job_preemptions_total = global_registry.counter(
+    "tpu_job_preemptions_total",
+    "Checkpoint-preempt-requeue round trips, by cause (reclaim = the "
+    "oversubscription reclaimer took the slice; host-loss = TPU host "
+    "preemption/readiness lost mid-run; user = operator-requested)",
+    labels=("cause",),
+)
+tpu_job_requeues_total = global_registry.counter(
+    "tpu_job_requeues_total",
+    "Preempted -> Pending requeues (each resumes from the saved step)",
+)
+tpu_job_goodput_ratio = global_registry.gauge(
+    "tpu_job_goodput_ratio",
+    "Cumulative productive step-time / wallclock across completed jobs: "
+    "run-seconds whose progress survived (banked at checkpoint acks) over "
+    "submit->terminal wall time — queue waits, preemption round trips, and "
+    "progress lost since the last checkpoint all burn the ratio",
+)
+
+# cumulative goodput accumulators behind the gauge (module-level so every
+# controller instance in a process feeds one ratio, the record_claim idiom);
+# locked: terminal jobs land from concurrent reconcile workers
+_goodput = {"productive_s": 0.0, "wall_s": 0.0}
+_goodput_lock = threading.Lock()
+
+
+def record_job_outcome(productive_s: float, wall_s: float) -> None:
+    """One terminal job's contribution to the cumulative goodput ratio."""
+    with _goodput_lock:
+        _goodput["productive_s"] += max(0.0, productive_s)
+        _goodput["wall_s"] += max(0.0, wall_s)
+        if _goodput["wall_s"] > 0:
+            tpu_job_goodput_ratio.set(
+                min(1.0, _goodput["productive_s"] / _goodput["wall_s"])
+            )

@@ -154,10 +154,12 @@ class SLO:
 def default_slos() -> Tuple[SLO, ...]:
     """The operator's shipped objectives over series that PR 2/PR 4 already
     emit (ci/slo_lint.sh checks every referenced family exists). ISSUE 9
-    added the serving pair over the continuous-batching engine's families —
-    importing them here keeps the lint's live-registry contract honest on a
-    manager image that never loads the workload libraries."""
+    added the serving pair over the continuous-batching engine's families,
+    ISSUE 10 the batch-job completion objective — importing both here keeps
+    the lint's live-registry contract honest on a manager image that never
+    loads the workload libraries."""
     from ..serving import metrics as _serving_metrics  # noqa: F401
+    from . import jobmetrics as _jobmetrics  # noqa: F401
 
     return (
         SLO(
@@ -238,6 +240,18 @@ def default_slos() -> Tuple[SLO, ...]:
             "backpressure, errors, and drain-canceled requests burn the "
             "budget — shedding load is visible, never free)",
             category="serving",
+        ),
+        SLO(
+            "job-completion",
+            objective=0.90,
+            indicator=EventRatioIndicator(
+                "tpu_jobs_total", good_labels=(("result", "succeeded"),)
+            ),
+            description="90% of batch/RL jobs reaching a terminal state "
+            "Succeed — preemption round trips are free (checkpoint-"
+            "preempt-requeue survives them) but backoffLimit/maxRuntime "
+            "failures burn the budget",
+            category="batch",
         ),
     )
 

@@ -1,0 +1,39 @@
+"""Standalone serving entrypoint: `python -m odh_kubeflow_tpu.serving`.
+
+Runs in the serving pod behind the inference controller's HTTPRoute:
+builds the continuous-batching engine from the SERVING_* env the
+controller stamped into the template (model from SERVING_CHECKPOINT, the
+promotion lineage), starts its decode loop, and serves POST /generate +
+/healthz + /stats on SERVING_PORT (default 8000, the port the endpoint
+Service targets).
+"""
+import logging
+import os
+import signal
+import threading
+
+from .server import ServingHTTPServer, build_engine_from_env
+
+logging.basicConfig(level=logging.INFO)
+log = logging.getLogger("odh_kubeflow_tpu.serving")
+
+
+def main() -> None:
+    port = int(os.environ.get("SERVING_PORT", "8000"))
+    engine = build_engine_from_env().start()
+    server = ServingHTTPServer(engine, host="0.0.0.0", port=port)
+    host, bound_port = server.start()
+    log.info("serving on %s:%s", host, bound_port)
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    drain_s = float(os.environ.get("SERVING_DRAIN_TIMEOUT_S", "5"))
+    server.stop(drain_timeout_s=drain_s)
+    # the TPU runtime may hold non-daemon threads that would block a clean
+    # interpreter exit; a serving pod must honor its terminationGracePeriod
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,174 @@
+"""In-pod HTTP front of the continuous-batching engine (ISSUE 10 satellite,
+the ISSUE 9 follow-up): `python -m odh_kubeflow_tpu.serving` runs this next
+to the TPU in the serving image, behind the HTTPRoute the inference
+controller programs at `/serving/{ns}/{name}` — until now the engine was
+only ever driven in-process by tests/bench/loadtest.
+
+Surface (the engine's own backpressure semantics, over the wire):
+
+- ``POST /generate`` ``{"prompt": [ints], "max_new": n}`` → blocks until
+  the sequence completes → ``{"tokens": [...], "ttft_s": ..., "result":
+  "ok"}``. A full admission queue is an explicit **429** (the QueueFull
+  contract — shedding load must reach the serving-availability SLO, never
+  an unbounded buffer); a drain-canceled request is a **503**. An incoming
+  ``traceparent`` header joins the request to the endpoint's trace.
+- ``GET /healthz`` → 200 once the engine loop is up (the kubelet's gate).
+- ``GET /stats`` → the engine's live counters (slots, queue, tokens).
+
+The engine shape comes from the ``SERVING_*`` env the inference
+controller stamps into the pod template (controllers/inference.py
+_default_container); the model comes from ``SERVING_CHECKPOINT`` (orbax,
+the promotion lineage) via `build_engine_from_env`.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler
+from typing import Optional, Tuple
+
+from ..utils.httpserve import ThreadedHTTPServer, respond, serve_in_thread
+
+log = logging.getLogger(__name__)
+
+REQUEST_TIMEOUT_S = 120.0
+
+
+def build_engine_from_env(environ=None):
+    """Engine + model from the pod env (SERVING_* set by the controller).
+    SERVING_CHECKPOINT names the orbax dir saved by the promotion source;
+    without one a tiny random-weight demo model serves (the smoke shape —
+    a real deployment always has lineage)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import TransformerConfig, init_params
+    from .engine import ServingEngine
+
+    env = environ if environ is not None else os.environ
+    max_slots = int(env.get("SERVING_MAX_SLOTS", "8"))
+    max_seq = int(env.get("SERVING_MAX_SEQ", "512"))
+    max_queue = int(env.get("SERVING_MAX_QUEUE", "64"))
+    burst = int(env.get("SERVING_DECODE_BURST", "8"))
+    ckpt = env.get("SERVING_CHECKPOINT", "")
+    if ckpt:
+        from ..models.checkpoint import restore_train_state
+
+        cfg = TransformerConfig(**json.loads(env["SERVING_MODEL_CONFIG"])) \
+            if env.get("SERVING_MODEL_CONFIG") else None
+        if cfg is None:
+            raise RuntimeError(
+                "SERVING_CHECKPOINT set without SERVING_MODEL_CONFIG: the "
+                "restore needs the model shape to allocate against"
+            )
+        like = init_params(jax.random.PRNGKey(0), cfg)
+        state = restore_train_state(ckpt, {"params": like})
+        params = state["params"]
+    else:
+        cfg = TransformerConfig(
+            vocab=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq=max_seq, dtype=jnp.float32, use_flash=False,
+            remat=False,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        log.warning("no SERVING_CHECKPOINT: serving a demo model "
+                    "(random weights)")
+    return ServingEngine(
+        params, cfg, max_slots=max_slots, max_seq=max_seq,
+        max_queue_depth=max_queue, decode_burst=burst,
+    )
+
+
+class ServingHTTPServer:
+    """The threaded HTTP front. `start()` binds and runs the handler pool;
+    the engine's own daemon loop (engine.start()) does the decoding."""
+
+    def __init__(self, engine, host: str = "0.0.0.0", port: int = 8000):
+        self.engine = engine
+        self._requested = (host, port)
+        self.httpd: Optional[ThreadedHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> Tuple[str, int]:
+        from .engine import QueueFull
+
+        engine = self.engine
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("serving http: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    respond(self, 200, b'{"ok": true}')
+                elif self.path == "/stats":
+                    respond(self, 200, json.dumps(engine.stats()).encode())
+                else:
+                    respond(self, 404, b'{"error": "not found"}')
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    respond(self, 404, b'{"error": "not found"}')
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    prompt = [int(t) for t in body["prompt"]]
+                    max_new = int(body.get("max_new", 16))
+                except (KeyError, TypeError, ValueError) as e:
+                    respond(self, 400, json.dumps(
+                        {"error": f"bad request: {e}"}
+                    ).encode())
+                    return
+                try:
+                    handle = engine.submit(
+                        prompt, max_new=max_new,
+                        traceparent=self.headers.get("traceparent"),
+                    )
+                except QueueFull as e:
+                    # the engine's backpressure contract over the wire
+                    respond(self, 429, json.dumps(
+                        {"error": str(e), "result": "rejected"}
+                    ).encode())
+                    return
+                except ValueError as e:
+                    respond(self, 400, json.dumps(
+                        {"error": str(e)}
+                    ).encode())
+                    return
+                if not handle.wait(timeout=REQUEST_TIMEOUT_S):
+                    respond(self, 503, json.dumps(
+                        {"error": "generation timed out", "result": "error"}
+                    ).encode())
+                    return
+                if handle.result != "ok":
+                    # drain-canceled: fail fast, the route is already down
+                    respond(self, 503, json.dumps(
+                        {"result": handle.result}
+                    ).encode())
+                    return
+                respond(self, 200, json.dumps({
+                    "tokens": handle.tokens,
+                    "ttft_s": handle.ttft_s,
+                    "result": handle.result,
+                }).encode())
+
+        host, port = self._requested
+        self.httpd = ThreadedHTTPServer((host, port), Handler)
+        self._thread = serve_in_thread(self.httpd, "serving-http")
+        bound = self.httpd.server_address
+        log.info("serving engine HTTP on %s:%s", bound[0], bound[1])
+        return bound[0], bound[1]
+
+    def stop(self, drain_timeout_s: float = 0.0) -> None:
+        from ..utils.httpserve import shutdown
+
+        if self.httpd is not None:
+            shutdown(self.httpd)
+            self.httpd = None
+        self.engine.stop(drain_timeout_s=drain_timeout_s)

@@ -94,8 +94,9 @@ def check_machine_transitions(ctx: WriteContext) -> Optional[str]:
     (analysis/machines.py — the same specs the static machine-conformance
     checker enforces on the write SITES). Each machine is judged only
     against writes of its own kind: the suspend/repair/culling machines on
-    Notebooks, the inference machine on InferenceEndpoints."""
-    if ctx.kind not in ("Notebook", "InferenceEndpoint"):
+    Notebooks, the inference machine on InferenceEndpoints, the job machine
+    on TPUJobs."""
+    if ctx.kind not in ("Notebook", "InferenceEndpoint", "TPUJob"):
         return None
     from ..analysis.machines import MACHINES
     from ..controllers import constants as C

@@ -1,0 +1,660 @@
+"""Gang-scheduled batch/RL TPUJobs (ISSUE 10): all-or-nothing gang
+admission (anakin single-gang + sebulba dual-gang atomicity), warm-claim
+fast starts off a suspended notebook's slice, checkpoint-preempt-requeue
+under the three-class reclaim ordering, host-preemption survival, the
+budget queue, and the seeded mixed bad-day soak asserting no job is ever
+silently stuck in Admitted/Preempted.
+
+Deterministic tier-1 tests (marker: job); ci/faults.sh reruns the fault
+lane under REPEAT + RACECHECK=1 + INVCHECK=1.
+"""
+import json
+import time
+from dataclasses import replace
+
+import pytest
+
+from odh_kubeflow_tpu.api.core import Container, Event, Node, Pod
+from odh_kubeflow_tpu.api.apps import StatefulSet
+from odh_kubeflow_tpu.api.job import LAYOUT_SEBULBA, TPUJob
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.cluster import SimCluster, SlicePool
+from odh_kubeflow_tpu.cluster.faults import seeded_bad_day
+from odh_kubeflow_tpu.cluster.scheduler import (
+    Scheduler,
+    claim_owner_labels,
+    pod_claim_owner,
+)
+from odh_kubeflow_tpu.controllers import (
+    Config,
+    NotebookReconciler,
+    ProbeStatusController,
+    SuspendResumeController,
+    TPUJobReconciler,
+    constants as C,
+)
+from odh_kubeflow_tpu.controllers.job import job_gangs, job_priority
+from odh_kubeflow_tpu.probe import sim_agent_behavior
+from odh_kubeflow_tpu.runtime import Manager
+from odh_kubeflow_tpu.runtime import jobmetrics as JM
+from odh_kubeflow_tpu.runtime.flightrecorder import recorder
+from odh_kubeflow_tpu.tpu import GKE_NODEPOOL_LABEL, plan_slice
+
+pytestmark = pytest.mark.job
+
+NS = "batch"
+STEP_PER_CKPT = 30
+
+FAST = Config(
+    enable_culling=False,
+    suspend_enabled=True,
+    readiness_probe_period_s=0.15,
+    suspend_checkpoint_window_s=1.0,
+    resume_timeout_s=20.0,
+    reclaim_pending_grace_s=0.3,
+    job_checkpoint_window_s=2.0,
+    job_requeue_backoff_s=0.1,
+)
+
+
+def build_env(config=FAST, slices=2):
+    """Full three-actor stack (notebook + suspend/reclaim + job controllers)
+    over one sim cluster. The workload's step counter lives at the
+    transport: every learner-gang /tpu/checkpoint ack advances it by
+    STEP_PER_CKPT and is remembered, so tests can assert a resumed job
+    restarts from a step its workload actually acked."""
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=slices)
+    steps = {}
+    acked = {}
+
+    def http_get(url, timeout=10.0):
+        if "/tpu/checkpoint" in url and "-learner-" in url:
+            name = url.split("//", 1)[1].split("-learner-", 1)[0]
+            steps[name] = steps.get(name, 0) + STEP_PER_CKPT
+            acked.setdefault(name, []).append(steps[name])
+            return 200, json.dumps(
+                {"saved": True, "step": steps[name]}
+            ).encode()
+        if "/tpu/checkpoint" in url:
+            # a churn notebook's suspend checkpoint: instant ack
+            return 200, json.dumps({"saved": True, "step": 1}).encode()
+        return cluster.http_get(url, timeout=timeout)
+
+    mgr = Manager(cluster.store)
+    NotebookReconciler(mgr, config).setup()
+    ProbeStatusController(mgr, config, http_get=http_get).setup()
+    SuspendResumeController(mgr, config, http_get=http_get).setup()
+    TPUJobReconciler(mgr, config, http_get=http_get).setup()
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    mgr.start()
+    return cluster, mgr, acked
+
+
+@pytest.fixture()
+def env():
+    cluster, mgr, acked = build_env()
+    yield cluster, mgr, acked
+    mgr.stop()
+    cluster.stop()
+    cluster.faults.clear()
+
+
+def mk_job(name, steps=90, period=0.2, priority=0, layout=None, actors=None,
+           backoff_limit=3, max_runtime_s=0.0):
+    job = TPUJob()
+    job.metadata.name = name
+    job.metadata.namespace = NS
+    job.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    job.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2",
+                           priority=priority)
+    job.spec.steps = steps
+    job.spec.checkpoint_period_s = period
+    job.spec.backoff_limit = backoff_limit
+    job.spec.max_runtime_s = max_runtime_s
+    if layout:
+        job.spec.layout = layout
+    if actors:
+        job.spec.actors = actors
+    return job
+
+
+def mk_nb(name, priority=0):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = NS
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    nb.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2",
+                          priority=priority)
+    return nb
+
+
+def wait_for(fn, timeout=30, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def get_job(cluster, name):
+    return cluster.client.get(TPUJob, NS, name)
+
+
+def job_state(cluster, name):
+    return get_job(cluster, name).metadata.annotations.get(
+        C.JOB_STATE_ANNOTATION, ""
+    )
+
+
+def job_pods(cluster, name):
+    return [
+        p
+        for p in cluster.client.list(
+            Pod, namespace=NS, labels={C.JOB_NAME_LABEL: name}
+        )
+        if not p.metadata.deletion_timestamp
+    ]
+
+
+def patch_persistent(cluster, kind, name, patch, attempts=40):
+    """Scenario-driver writes must land even while a seeded bad day throws
+    409/429 at everything — the fault being scripted must not eat the
+    script (the test_suspend idiom)."""
+    from odh_kubeflow_tpu.apimachinery import (
+        ConflictError,
+        TooManyRequestsError,
+    )
+
+    for i in range(attempts):
+        try:
+            cluster.client.patch(kind, NS, name, patch)
+            return
+        except (ConflictError, TooManyRequestsError):
+            if i == attempts - 1:
+                raise
+            time.sleep(0.02)
+
+
+def stop_nb(cluster, name):
+    # the culler's atomic stamp: stop + checkpointing ride one patch
+    patch_persistent(cluster, Notebook, name, {"metadata": {"annotations": {
+        C.STOP_ANNOTATION: "2026-01-01T00:00:00Z",
+        C.TPU_SUSPEND_STATE_ANNOTATION: "checkpointing",
+    }}})
+
+
+def events(cluster, reason):
+    return [
+        e for e in cluster.client.list(Event, namespace=NS)
+        if e.reason == reason
+    ]
+
+
+# ---------------------------------------------------------------------------
+# admission + completion
+# ---------------------------------------------------------------------------
+
+
+def test_anakin_job_runs_to_succeeded(env):
+    """The happy path end to end: gang admission, every host ready, cadence
+    checkpoints banking acked steps, Succeeded at steps*completions — and
+    the slice fully released (replicas 0, pods gone) afterwards."""
+    cluster, mgr, acked = env
+    ok0 = JM.tpu_jobs_total.value(result="succeeded")
+    cluster.client.create(mk_job("rl-a", steps=90))
+    wait_for(lambda: job_state(cluster, "rl-a") == "running", msg="running")
+    wait_for(lambda: get_job(cluster, "rl-a").status.phase == "Succeeded",
+             timeout=40, msg="succeeded")
+    job = get_job(cluster, "rl-a")
+    assert job.status.completed_steps >= 90
+    # terminal park: replicas scaled away, no pods left behind
+    wait_for(lambda: not job_pods(cluster, "rl-a"), msg="pods torn down")
+    sts = cluster.client.get(StatefulSet, NS, "rl-a-learner")
+    assert sts.spec.replicas == 0
+    assert JM.tpu_jobs_total.value(result="succeeded") == ok0 + 1
+    # the workload acked every banked step through the transport
+    assert acked["rl-a"], "no checkpoint ack ever reached the workload"
+    assert job.status.completed_steps in acked["rl-a"]
+
+
+def test_sebulba_admission_is_atomic(env):
+    """A sebulba job secures BOTH gangs or neither: with one warm slice and
+    zero free capacity the learner's warm claim must unwind (back to warm,
+    unclaimed) and no workload may exist; once a second slice frees, both
+    gangs admit together."""
+    cluster, mgr, acked = env
+    pool = SlicePool(cluster.client)
+    # nb1 occupies slice 1; stopping it releases slice 1 warm — ONE warm
+    # slice in a 2-slice cluster whose other slice nb2 keeps occupied
+    cluster.client.create(mk_nb("nb1"))
+    cluster.client.create(mk_nb("nb2"))
+    wait_for(
+        lambda: sum(
+            1 for p in cluster.client.list(Pod, namespace=NS)
+            if p.is_ready()
+        ) >= 2,
+        msg="notebooks up",
+    )
+    stop_nb(cluster, "nb1")
+    wait_for(lambda: any(e.state == "warm" for e in pool.entries()),
+             msg="warm slice")
+
+    job = mk_job("sebulba", steps=60, layout=LAYOUT_SEBULBA,
+                 actors=TPUSpec(accelerator="v5e", topology="2x2"))
+    cluster.client.create(job)
+    # the actor gang has nowhere to go: admission must keep unwinding —
+    # the warm slice stays warm (not leaked claimed) and nothing is created
+    time.sleep(1.5)
+    assert job_state(cluster, "sebulba") == ""
+    assert not job_pods(cluster, "sebulba")
+    entries = pool.entries()
+    assert entries and all(e.state == "warm" for e in entries), \
+        "partial sebulba admission leaked a claim"
+    qcond = next(
+        (c for c in get_job(cluster, "sebulba").status.conditions
+         if c.type == C.JOB_QUEUED_CONDITION),
+        None,
+    )
+    assert qcond is not None and qcond.status == "True"
+
+    # free the second slice: both gangs must now admit together
+    cluster.client.delete(Notebook, NS, "nb2")
+    wait_for(lambda: job_state(cluster, "sebulba") == "running", timeout=40,
+             msg="sebulba running")
+    gangs = {p.metadata.labels.get(C.JOB_GANG_LABEL)
+             for p in job_pods(cluster, "sebulba")}
+    assert gangs == {C.JOB_GANG_LEARNER, C.JOB_GANG_ACTORS}
+    wait_for(lambda: job_state(cluster, "sebulba") == "succeeded",
+             timeout=40, msg="sebulba succeeded")
+
+
+def test_warm_claim_fast_start():
+    """A suspended notebook's released slice is a batch job's fast start:
+    in a one-slice cluster the job can only admit through the warm pool,
+    under its own claim key."""
+    cluster, mgr, acked = build_env(slices=1)
+    try:
+        cluster.client.create(mk_nb("nb"))
+        wait_for(
+            lambda: any(p.is_ready()
+                        for p in cluster.client.list(Pod, namespace=NS)),
+            msg="notebook up",
+        )
+        stop_nb(cluster, "nb")
+        pool = SlicePool(cluster.client)
+        wait_for(lambda: any(e.state == "warm" for e in pool.entries()),
+                 msg="warm slice")
+        cluster.client.create(mk_job("rl-w", steps=60))
+        wait_for(lambda: job_state(cluster, "rl-w") == "running",
+                 msg="running off the warm claim")
+        admitted = events(cluster, "JobAdmitted")
+        assert admitted and "warm claim" in admitted[-1].message
+        wait_for(lambda: job_state(cluster, "rl-w") == "succeeded",
+                 timeout=40, msg="succeeded")
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+def test_over_budget_job_queues_with_condition():
+    """Demand past CHIP_BUDGET queues with QueuedOverBudget — the job must
+    not reclaim anything and must create no workload while it waits."""
+    cluster, mgr, acked = build_env(
+        config=replace(FAST, chip_budget=4), slices=2
+    )
+    try:
+        cluster.client.create(mk_nb("nb"))
+        wait_for(
+            lambda: any(p.is_ready()
+                        for p in cluster.client.list(Pod, namespace=NS)),
+            msg="notebook up",
+        )
+        cluster.client.create(mk_job("rl-q", steps=60))
+        wait_for(lambda: events(cluster, "JobQueuedOverBudget"),
+                 msg="queued event")
+        assert job_state(cluster, "rl-q") == ""
+        assert not job_pods(cluster, "rl-q")
+        # the running notebook was never victimized for over-budget demand
+        nb = cluster.client.get(Notebook, NS, "nb")
+        assert not nb.metadata.annotations.get(C.TPU_SUSPEND_STATE_ANNOTATION)
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+def test_free_slice_admission_reserves_through_the_pool():
+    """Free-slice gang admission must RESERVE, not count: the pool is
+    parked and claimed under the job's key via the lead-node CAS, so two
+    jobs racing for the same free slice resolve at the claim — the loser's
+    admission fails cleanly instead of both admitting and one wedging
+    unbound in Admitted (the check-then-act hole a bare free-pool count
+    would leave open, fatal for a pair of sebulba jobs)."""
+    cluster = SimCluster().start()
+    try:
+        cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=1)
+        ctrl = TPUJobReconciler(Manager(cluster.store), FAST)
+        pool = SlicePool(cluster.client)
+        a, b = mk_job("race-a"), mk_job("race-b")
+        ok_a, claims_a = ctrl._secure_gangs(a, job_gangs(a), f"{NS}/race-a")
+        assert ok_a and claims_a
+        entries = pool.entries()
+        assert [e.claimed_by for e in entries] == [f"{NS}/race-a"]
+        # the second job sees a CLAIMED pool, not a free one — no double
+        # admission off one slice
+        ok_b, _ = ctrl._secure_gangs(b, job_gangs(b), f"{NS}/race-b")
+        assert not ok_b
+        # ...and the failed pass left no residue: the winner's claim is
+        # intact and nothing else got parked
+        entries = pool.entries()
+        assert [e.claimed_by for e in entries] == [f"{NS}/race-a"]
+        # re-securing the SAME job is idempotent (restart mid-admission)
+        ok_a2, claims_a2 = ctrl._secure_gangs(
+            a, job_gangs(a), f"{NS}/race-a"
+        )
+        assert ok_a2 and claims_a2 == claims_a
+    finally:
+        cluster.stop()
+        cluster.faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-preempt-requeue
+# ---------------------------------------------------------------------------
+
+
+def test_reclaim_preempts_job_and_it_survives():
+    """The three-class contention story on one slice: a default-priority
+    batch job (-10) loses its slice to an arriving interactive notebook (0)
+    through checkpoint-before-preempt, requeues, warm-claims the slice back
+    when the notebook suspends, resumes from a step its workload ACKED, and
+    still completes."""
+    cluster, mgr, acked = build_env(
+        config=replace(FAST, chip_budget=8), slices=1
+    )
+    try:
+        pre0 = JM.tpu_job_preemptions_total.value(cause="reclaim")
+        cluster.client.create(mk_job("rl-p", steps=300))
+        wait_for(lambda: job_state(cluster, "rl-p") == "running",
+                 msg="running")
+        # the interactive user arrives: 4 + 4 = 8 chips inside budget 8,
+        # zero free capacity -> the reclaimer must take the batch slice
+        cluster.client.create(mk_nb("user"))
+        wait_for(
+            lambda: int(get_job(cluster, "rl-p").metadata.annotations.get(
+                C.JOB_PREEMPTIONS_ANNOTATION, "0") or 0) >= 1,
+            msg="job preempted and requeued",
+        )
+        assert JM.tpu_job_preemptions_total.value(cause="reclaim") > pre0
+        wait_for(
+            lambda: (lambda nb: nb.status.tpu is not None
+                     and nb.status.tpu.mesh_ready)(
+                cluster.client.get(Notebook, NS, "user")),
+            timeout=40, msg="notebook on the reclaimed slice",
+        )
+        # ...and goes idle: the suspension hands the slice back warm and
+        # the preempted job resumes from its saved step
+        stop_nb(cluster, "user")
+        wait_for(lambda: job_state(cluster, "rl-p") == "succeeded",
+                 timeout=60, msg="job survived the preemption")
+        job = get_job(cluster, "rl-p")
+        resume_step = int(job.metadata.annotations.get(
+            C.JOB_RESUME_STEP_ANNOTATION, "0") or 0)
+        assert resume_step in acked["rl-p"], (
+            f"resumed from step {resume_step} which the workload never "
+            f"acked (acked: {acked['rl-p']})"
+        )
+        assert job.status.preemptions >= 1
+        assert job.status.failures == 0, \
+            "a reclaim-driven preemption must not charge backoffLimit"
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+def test_host_preemption_mid_running_survival(env):
+    """TPU host preemption mid-Running: the gang's readiness drops, the job
+    parks Preempted (charging backoffLimit once — no preempt notice), the
+    requeue re-places on the remaining slice, and the job completes from
+    its acked checkpoint step."""
+    cluster, mgr, acked = env
+    host0 = JM.tpu_job_preemptions_total.value(cause="host-loss")
+    cluster.client.create(mk_job("rl-h", steps=300))
+    wait_for(lambda: job_state(cluster, "rl-h") == "running", msg="running")
+    wait_for(lambda: acked.get("rl-h"), msg="first checkpoint banked")
+    victim_node = job_pods(cluster, "rl-h")[0].spec.node_name
+    cluster.preempt_node(victim_node, grace_s=0.1)
+    wait_for(
+        lambda: int(get_job(cluster, "rl-h").metadata.annotations.get(
+            C.JOB_PREEMPTIONS_ANNOTATION, "0") or 0) >= 1,
+        msg="preempted + requeued",
+    )
+    assert JM.tpu_job_preemptions_total.value(cause="host-loss") > host0
+    wait_for(lambda: job_state(cluster, "rl-h") == "succeeded", timeout=60,
+             msg="job survived host preemption")
+    job = get_job(cluster, "rl-h")
+    resume_step = int(job.metadata.annotations.get(
+        C.JOB_RESUME_STEP_ANNOTATION, "0") or 0)
+    assert resume_step in acked["rl-h"]
+    assert job.status.failures >= 1, \
+        "an unexplained host loss must charge backoffLimit"
+    cluster.restore_node(victim_node)
+
+
+def test_preempted_slice_parks_warm_at_job_priority():
+    """ISSUE 10 bugfix sweep: a non-reclaim preemption parks the job's
+    slice warm at the JOB's priority — a priority-0 park would make it the
+    first idle-reclaim victim, defeating the fast requeue."""
+    cluster, mgr, acked = build_env(
+        # a long requeue backoff freezes the Preempted->Pending window so
+        # the parked pool entry can be inspected before the re-claim
+        config=replace(FAST, job_requeue_backoff_s=30.0), slices=1
+    )
+    try:
+        cluster.client.create(mk_job("rl-park", steps=300, priority=-5))
+        wait_for(lambda: job_state(cluster, "rl-park") == "running",
+                 msg="running")
+        cluster.client.patch(TPUJob, NS, "rl-park", {"metadata": {
+            "annotations": {C.JOB_PREEMPT_ANNOTATION: "user"}}})
+        wait_for(
+            lambda: job_state(cluster, "rl-park") in ("preempted", ""),
+            msg="parked",
+        )
+        pool = SlicePool(cluster.client)
+        wait_for(lambda: any(e.state == "warm" for e in pool.entries()),
+                 msg="slice released warm")
+        entry = next(e for e in pool.entries() if e.state == "warm")
+        assert entry.priority == -5, (
+            f"preempted job's slice parked at priority {entry.priority}, "
+            "not the job's own -5"
+        )
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+def test_checkpointing_job_never_victimized():
+    """ISSUE 10 bugfix sweep (the Draining rule's mirror): the reclaimer
+    must never stamp a preempt onto a job mid-Checkpointing — its save is
+    exactly what makes the preemption survivable."""
+    cluster = SimCluster().start()
+    try:
+        cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=1)
+        mgr = Manager(cluster.store)
+        suspend = SuspendResumeController(mgr, FAST)
+
+        def park(name, *states):
+            # walk the machine legally (INVCHECK judges every write):
+            # Pending -> Admitted -> Running (-> Checkpointing)
+            cluster.client.create(mk_job(name))
+            for state in states:
+                cluster.client.patch(TPUJob, NS, name, {"metadata": {
+                    "annotations": {C.JOB_STATE_ANNOTATION: state}}})
+
+        park("mid-window", "admitted", "running", "checkpointing")
+        shape = plan_slice("v5e", "2x2")
+        assert suspend._pick_job_victim(mk_nb("user"), shape) is None
+
+        park("fair-game", "admitted", "running")
+        victim = suspend._pick_job_victim(mk_nb("user"), shape)
+        assert victim is not None and victim.metadata.name == "fair-game"
+    finally:
+        cluster.stop()
+        cluster.faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# failure semantics
+# ---------------------------------------------------------------------------
+
+
+def test_max_runtime_fails_with_incident(env):
+    """maxRuntime is a hard wallclock cap: Failed is terminal, mirrored to
+    status, counted, and snapshotted as an incident bundle."""
+    cluster, mgr, acked = env
+    fail0 = JM.tpu_jobs_total.value(result="failed")
+    cluster.client.create(mk_job("rl-f", steps=100000, max_runtime_s=2.0))
+    # the terminal side effects (annotation, mirror, counter, incident)
+    # land in sequence inside _fail — wait on each, don't race them
+    wait_for(lambda: get_job(cluster, "rl-f").status.phase == "Failed",
+             timeout=30, msg="failed on maxRuntime")
+    assert job_state(cluster, "rl-f") == "failed"
+    wait_for(lambda: JM.tpu_jobs_total.value(result="failed") == fail0 + 1,
+             msg="failed counted")
+    wait_for(
+        lambda: any(
+            i["reason"] == "job-failed" and i["subject"] == f"{NS}/rl-f"
+            for i in recorder.incidents()
+        ),
+        msg="Failed must leave an incident bundle",
+    )
+    wait_for(lambda: not job_pods(cluster, "rl-f"), msg="pods torn down")
+
+
+# ---------------------------------------------------------------------------
+# the shared claim-owner table (ISSUE 10 satellite refactor)
+# ---------------------------------------------------------------------------
+
+
+def test_pod_claim_owner_table():
+    """The scheduler's claimed-pool owner check is one shared table across
+    all three workload classes — a pod names its owner through exactly one
+    of the class labels, and an owner-less pod never resolves."""
+    assert claim_owner_labels() == (
+        C.NOTEBOOK_NAME_LABEL, C.INFERENCE_NAME_LABEL, C.JOB_NAME_LABEL,
+    )
+    for label, owner in (
+        (C.NOTEBOOK_NAME_LABEL, "nb"),
+        (C.INFERENCE_NAME_LABEL, "ep"),
+        (C.JOB_NAME_LABEL, "rl"),
+    ):
+        pod = Pod()
+        pod.metadata.namespace = "ns"
+        pod.metadata.labels[label] = owner
+        assert pod_claim_owner(pod) == f"ns/{owner}"
+        # the static scheduler hook is the same table
+        assert Scheduler._pod_owner(pod) == f"ns/{owner}"
+    bare = Pod()
+    bare.metadata.namespace = "ns"
+    assert pod_claim_owner(bare) == ""
+
+
+# ---------------------------------------------------------------------------
+# seeded mixed bad day (ISSUE 10 acceptance: nothing silently stuck)
+# ---------------------------------------------------------------------------
+
+
+def _mixed_bad_day(seed):
+    """Jobs + notebook churn + a control-plane bad day + a host preemption
+    mid-Running in one 3-slice cluster: at the end every job must have
+    SUCCEEDED — none stuck in Admitted/Preempted with every actor idle —
+    and every survived preemption must have resumed from an acked step."""
+    cluster, mgr, acked = build_env(slices=3)
+    try:
+        jobs = ["soak-0", "soak-1"]
+        for name in jobs:
+            cluster.client.create(mk_job(name, steps=240))
+        cluster.client.create(mk_nb("churn"))
+        wait_for(
+            lambda: all(job_state(cluster, n) == "running" for n in jobs),
+            timeout=40, msg="jobs running",
+        )
+        seeded_bad_day(cluster.faults, seed=seed)
+        # one host preemption mid-Running, healed once the victim requeues
+        # (3 slices / 3 workloads: an unhealed host would starve the churn)
+        wait_for(lambda: acked.get(jobs[0]), timeout=40,
+                 msg="first checkpoint banked before the preemption")
+        victim_node = job_pods(cluster, jobs[0])[0].spec.node_name
+        cluster.preempt_node(victim_node, grace_s=0.1)
+        wait_for(
+            lambda: int(get_job(cluster, jobs[0]).metadata.annotations.get(
+                C.JOB_PREEMPTIONS_ANNOTATION, "0") or 0) >= 1,
+            timeout=40, msg="soak victim preempted",
+        )
+        cluster.restore_node(victim_node)
+        # interactive churn across the same capacity; only a fully-Active
+        # notebook is stopped (the culler's own precondition — stamping
+        # `checkpointing` mid-resume is not a legal machine transition)
+        for _ in range(2):
+            wait_for(
+                lambda: (lambda nb: nb.status.tpu is not None
+                         and nb.status.tpu.mesh_ready
+                         and not nb.metadata.annotations.get(
+                             C.TPU_SUSPEND_STATE_ANNOTATION))(
+                    cluster.client.get(Notebook, NS, "churn")),
+                timeout=40, msg="churn notebook ready",
+            )
+            stop_nb(cluster, "churn")
+            wait_for(
+                lambda: cluster.client.get(
+                    Notebook, NS, "churn"
+                ).metadata.annotations.get(
+                    C.TPU_SUSPEND_STATE_ANNOTATION) == "suspended",
+                timeout=40, msg="churn notebook suspended",
+            )
+            patch_persistent(cluster, Notebook, "churn", {"metadata": {
+                "annotations": {C.STOP_ANNOTATION: None}}})
+        # every job must converge to Succeeded: a job wedged in Admitted or
+        # Preempted here is exactly the silent-stuck bug the requeue
+        # contract exists to prevent
+        wait_for(
+            lambda: all(
+                job_state(cluster, n) == "succeeded" for n in jobs
+            ),
+            timeout=90,
+            msg="all jobs succeeded through the bad day "
+            + str({n: job_state(cluster, n) for n in jobs}),
+        )
+        for name in jobs:
+            job = get_job(cluster, name)
+            if int(job.metadata.annotations.get(
+                    C.JOB_PREEMPTIONS_ANNOTATION, "0") or 0):
+                resume_step = int(job.metadata.annotations.get(
+                    C.JOB_RESUME_STEP_ANNOTATION, "0") or 0)
+                # 0 = from scratch: legal only when the preemption landed
+                # before any save was BANKED (acked at the transport but
+                # not yet annotated counts as unbanked — that progress is
+                # exactly what "lost since the last checkpoint" means)
+                assert resume_step == 0 or resume_step in acked.get(name, []), (
+                    f"{name} resumed from unacked step {resume_step} "
+                    f"(acked: {acked.get(name)})"
+                )
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+def test_job_mixed_bad_day_soak():
+    _mixed_bad_day(seed=1007)
+
+
+@pytest.mark.slow
+def test_job_mixed_bad_day_soak_second_seed():
+    _mixed_bad_day(seed=2814)

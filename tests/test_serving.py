@@ -549,3 +549,70 @@ def test_prewarm_keeps_warm_slices_ahead_of_demand(env):
     entry = sp.claim("tpu-v5-lite-podslice", "2x2", f"{NS}/warm-claimer")
     assert entry is not None, "prewarmed slice was not claimable"
     sp.unclaim(entry.pool)
+
+
+# ---------------------------------------------------------------------------
+# in-pod HTTP entrypoint (ISSUE 10 satellite: `python -m odh_kubeflow_tpu.serving`)
+# ---------------------------------------------------------------------------
+
+
+def test_http_serving_entrypoint_smoke():
+    """The in-pod HTTP front end to end: an engine built from the SERVING_*
+    env (the controller's pod-template contract) behind ServingHTTPServer —
+    /healthz gates, /generate returns the engine's tokens, /stats exposes
+    the live counters, and bad input is a 400, all over a real socket."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from odh_kubeflow_tpu.serving.server import (
+        ServingHTTPServer,
+        build_engine_from_env,
+    )
+
+    engine = build_engine_from_env({
+        "SERVING_MAX_SLOTS": "2",
+        "SERVING_MAX_SEQ": "64",
+        "SERVING_MAX_QUEUE": "8",
+        "SERVING_DECODE_BURST": "4",
+    }).start()
+    server = ServingHTTPServer(engine, host="127.0.0.1", port=0)
+    host, port = server.start()
+    base = f"http://{host}:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert r.status == 200 and _json.load(r)["ok"] is True
+
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=_json.dumps({"prompt": [1, 2, 3], "max_new": 4}).encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            body = _json.load(r)
+        assert body["result"] == "ok"
+        assert len(body["tokens"]) == 4
+        assert body["ttft_s"] >= 0.0
+        # the wire path is the same engine: a direct submit agrees bitwise
+        direct = engine.submit([1, 2, 3], max_new=4)
+        assert direct.wait(timeout=60) and direct.tokens == body["tokens"]
+
+        with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+            stats = _json.load(r)
+        assert stats.get("completed", 0) >= 1 or stats
+
+        bad = urllib.request.Request(
+            f"{base}/generate", data=b'{"max_new": 4}', method="POST",
+        )
+        try:
+            urllib.request.urlopen(bad, timeout=10)
+            raise AssertionError("missing prompt must be a 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        try:
+            urllib.request.urlopen(f"{base}/nope", timeout=10)
+            raise AssertionError("unknown path must be a 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        server.stop()
