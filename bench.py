@@ -246,7 +246,16 @@ def bench_train_step():
     opt_state = opt.init(params)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab)
     batch_d = {"tokens": tokens}
-    step = jax.jit(step)
+    from odh_kubeflow_tpu.analysis import hotregions
+    from odh_kubeflow_tpu.utils import jaxguard
+
+    # donate params + opt_state: the step overwrites both wholesale, so
+    # donation lets XLA alias the update in place instead of holding two
+    # copies of every weight/optimizer buffer (the classic missed-donation
+    # bug jaxlint's donation-discipline checker exists for); the guard's
+    # compile counter doubles as the retrace regression gate below
+    compile_base = jaxguard.compile_count("bench.train_step")
+    step = jaxguard.jit(step, region="bench.train_step", donate_argnums=(0, 1))
 
     # warm (compile)
     params, opt_state, loss = step(params, opt_state, batch_d)
@@ -277,6 +286,16 @@ def bench_train_step():
     from odh_kubeflow_tpu.tpu import telemetry
 
     telemetry.observe_train_step(step_s, tokens=batch * seq, mfu_est=mfu)
+    # the declared compile budget (analysis/hotregions.py): the step traces
+    # exactly once; a retrace would poison the two-length slope AND means
+    # something shape-varying leaked into the step — fail the bench, not
+    # the vibe
+    budget = hotregions.get("bench.train_step").compile_budget
+    recompiles = jaxguard.compile_count("bench.train_step") - compile_base
+    assert recompiles <= budget, (
+        f"train step traced {recompiles}x, compile budget {budget} "
+        "(analysis/hotregions.py) — a retrace hazard landed in the step"
+    )
     return {
         "tokens_per_s": round(tokens_per_s),
         "step_ms": round(step_s * 1e3, 1),
@@ -286,6 +305,9 @@ def bench_train_step():
         "mfu_est": round(mfu, 3),
         "remat_policy": remat_policy or "none-saved",
         "final_loss": round(float(loss), 3),
+        "train_step_recompiles": recompiles,
+        "train_step_compile_budget": budget,
+        "donated": "params+opt_state (aliased in place; JAXGUARD audits)",
     }
 
 
@@ -625,28 +647,57 @@ def bench_serving():
     useful_tokens = sum(order)
     static_goodput = useful_tokens / static_s
 
-    # -- continuous batching: same requests, same slot count --
-    engine = ServingEngine(params, cfg, max_slots=slots, max_seq=max_seq,
-                           max_queue_depth=len(order) + 1, decode_burst=16)
-    # compile warm: prefill + one decode step
-    warm = engine.submit(list(prompts[0][:prompt_len]), max_new=2)
-    while not engine.idle():
-        engine.step()
-    assert warm.result == "ok"
+    # -- continuous batching: same requests, same slot count, run with the
+    # JAXGUARD compile/transfer budgets ARMED (ISSUE 12): the whole bench
+    # episode doubles as the compilation-discipline soak — a steady-state
+    # retrace or an in-burst host sync fails the bench here, not in a
+    # latency graph three PRs later --
+    import os
 
-    handles = []
-    step_samples = []  # (wall_s, active_slots) per decode step
-    t0 = time.perf_counter()
-    for i, n in enumerate(order):
-        handles.append(engine.submit(list(prompts[i]), max_new=n))
-    while not engine.idle():
-        s0 = time.perf_counter()
-        active = engine.stats()["active_slots"]
-        engine.step()
-        if active:
-            step_samples.append((time.perf_counter() - s0, active))
-    cb_s = time.perf_counter() - t0
-    cb_goodput = sum(len(h.tokens) for h in handles) / cb_s
+    from odh_kubeflow_tpu.analysis import hotregions
+    from odh_kubeflow_tpu.utils import jaxguard
+
+    jaxguard_prev = os.environ.get("JAXGUARD")
+    os.environ["JAXGUARD"] = "1"
+    try:
+        engine = ServingEngine(params, cfg, max_slots=slots, max_seq=max_seq,
+                               max_queue_depth=len(order) + 1, decode_burst=16)
+        # compile warm: prefill + one decode step
+        warm = engine.submit(list(prompts[0][:prompt_len]), max_new=2)
+        while not engine.idle():
+            engine.step()
+        assert warm.result == "ok"
+
+        handles = []
+        step_samples = []  # (wall_s, active_slots) per decode step
+        t0 = time.perf_counter()
+        for i, n in enumerate(order):
+            handles.append(engine.submit(list(prompts[i]), max_new=n))
+        while not engine.idle():
+            s0 = time.perf_counter()
+            active = engine.stats()["active_slots"]
+            engine.step()
+            if active:
+                step_samples.append((time.perf_counter() - s0, active))
+        cb_s = time.perf_counter() - t0
+        cb_goodput = sum(len(h.tokens) for h in handles) / cb_s
+    finally:
+        if jaxguard_prev is None:
+            os.environ.pop("JAXGUARD", None)
+        else:
+            os.environ["JAXGUARD"] = jaxguard_prev
+
+    guard_stats = engine.stats()
+    burst_budget = hotregions.get("serving.decode_burst").compile_budget
+    assert guard_stats["decode_burst_recompiles"] <= burst_budget, (
+        f"decode burst traced {guard_stats['decode_burst_recompiles']}x, "
+        f"compile budget {burst_budget} (analysis/hotregions.py) — a "
+        "retrace hazard landed in the serving engine"
+    )
+    assert guard_stats["host_transfers_last_burst"] == 1, (
+        f"{guard_stats['host_transfers_last_burst']} host transfers in the "
+        "last burst — steady state is exactly ONE batched post-burst drain"
+    )
 
     def pct(xs, p):
         if not xs:
@@ -678,6 +729,17 @@ def bench_serving():
             sum(a for _, a in step_samples) / (len(step_samples) or 1) / slots,
             3,
         ),
+        # ISSUE 12 counters, mined from the JAXGUARD compile/transfer guard
+        # (the bench asserts the budgets above — a regression fails here)
+        "decode_burst_recompiles": guard_stats["decode_burst_recompiles"],
+        "decode_burst_compile_budget": burst_budget,
+        "prefill_recompiles": guard_stats["prefill_recompiles"],
+        "host_transfers_per_burst": guard_stats["host_transfers_last_burst"],
+        # the r12 hot-loop transfer fix: the post-burst drain now pulls all
+        # five per-slot outputs in ONE device_get (was 5 host syncs per
+        # burst — at decode_burst=16 that's 5 tunnel round trips amortized
+        # to 1 per 16 tokens/slot)
+        "drain_note": "post-burst drain batched: 1 host sync per burst (was 5)",
     }
 
 

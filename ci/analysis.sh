@@ -15,8 +15,30 @@
 #   ./ci/analysis.sh --audit         # also show what the pragmas suppress
 #   ./ci/analysis.sh --machines      # machine-conformance + the systematic
 #                                    # interleaving explorer only (ISSUE 8)
+#   ./ci/analysis.sh --jax           # the jaxlint family + JAXGUARD contract
+#                                    # tests only (ISSUE 12)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--jax" ]]; then
+    # the data-plane discipline lane (ISSUE 12): the four jaxlint checkers
+    # package-wide (zero unsuppressed findings is the acceptance bar), the
+    # pragma budget gate, and the jaxlint/jaxguard contract tests
+    echo "== jaxlint static pass (retrace/transfer/donation/psum-axis) =="
+    python -m odh_kubeflow_tpu.analysis \
+        --check retrace-hazard --check host-transfer \
+        --check donation-discipline --check psum-axis odh_kubeflow_tpu
+    echo "== pragma budget gate =="
+    python -m odh_kubeflow_tpu.analysis --pragma-gate ci/pragma_allowlist.txt
+    if python -m pytest --version >/dev/null 2>&1; then
+        echo "== jaxlint/jaxguard contract tests =="
+        JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+            tests/test_analysis.py tests/test_jaxguard.py -q \
+            -m "analysis and not slow" \
+            -p no:cacheprovider -p no:randomly
+    fi
+    exit 0
+fi
 
 if [[ "${1:-}" == "--machines" ]]; then
     echo "== machine-conformance static pass =="

@@ -99,6 +99,15 @@ echo "=== serving lane: INVCHECK=1 iteration ==="
 INVCHECK=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
+# ...and one with the compile/transfer/donation guard armed (utils/
+# jaxguard.py, ISSUE 12): the decode burst must hold its declared compile
+# budget with ZERO in-region host transfers, prefill stays within its one
+# budgeted fetch, and every donated KV-cache buffer must actually alias —
+# the serving soak doubles as a compilation-discipline run
+echo "=== serving lane: JAXGUARD=1 iteration ==="
+JAXGUARD=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
 # job lane (ISSUE 10): the gang-scheduled TPUJob machine under faults —
 # host preemption mid-Running (checkpoint-preempt-requeue, resume from the
 # acked step), the reclaimer taking a batch slice for an interactive
@@ -119,4 +128,11 @@ echo "=== job lane: INVCHECK=1 iteration ==="
 INVCHECK=1 python -m pytest tests/test_job.py -q -m "job and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, incl. slice chaos + pool churn + serving + job) ==="
+# the job lane's generate()/train-step paths run under the same guard: any
+# jitted entry point that retraces per call or silently drops a donation
+# fails here (ISSUE 12)
+echo "=== job lane: JAXGUARD=1 iteration ==="
+JAXGUARD=1 python -m pytest tests/test_job.py -q -m "job and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, +1 jaxguard on serving/job, incl. slice chaos + pool churn + serving + job) ==="

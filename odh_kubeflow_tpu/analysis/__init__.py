@@ -22,6 +22,12 @@ invariants the generic linters cannot know about:
                        transition declared in `machines.py` — the three
                        annotation-durable machines as data
                        (checkers/machine_conformance.py)
+- ``retrace-hazard`` / ``host-transfer`` / ``donation-discipline`` /
+  ``psum-axis``        the jaxlint family: data-plane compile-cache
+                       hygiene, host-sync surfaces inside declared hot
+                       regions (`hotregions.py`), buffer-donation
+                       discipline, and collective-axis sanity
+                       (checkers/jaxlint.py)
 
 Intentional exceptions are recorded inline with ``# lint: disable=<check>``
 pragmas (comma-separated check names, or ``all``) and budgeted in
@@ -29,8 +35,10 @@ pragmas (comma-separated check names, or ``all``) and budgeted in
 any unsuppressed finding or unreviewed pragma. The runtime half of the
 tooling — the instrumented lock + cache write barrier that turns chaos runs
 into race runs (`utils/racecheck.py`), the INVCHECK store-write invariant
-monitor (`utils/invcheck.py`), and the systematic interleaving explorer
-(`explore.py`) — shares the `machines.py` specs with the static checker.
+monitor (`utils/invcheck.py`), the JAXGUARD compile/transfer/donation guard
+(`utils/jaxguard.py`, sharing `hotregions.py` with the jaxlint checkers),
+and the systematic interleaving explorer (`explore.py`) — shares the
+machine/region specs with the static checkers.
 """
 from .framework import (  # noqa: F401
     Checker,

@@ -7,6 +7,12 @@ from ..framework import Checker
 from .cache_mutation import CacheMutationChecker
 from .conventions import AnnotationConventionChecker, MetricConventionChecker
 from .exceptions import SwallowedExceptionChecker
+from .jaxlint import (
+    DonationDisciplineChecker,
+    HostTransferChecker,
+    PsumAxisChecker,
+    RetraceHazardChecker,
+)
 from .lock_discipline import LockDisciplineChecker, LockOrderChecker
 from .machine_conformance import MachineConformanceChecker
 
@@ -23,4 +29,10 @@ def make_checkers() -> List[Checker]:
         MetricConventionChecker(),
         AnnotationConventionChecker(),
         MachineConformanceChecker(),
+        # the jaxlint family (ISSUE 12): data-plane compilation/transfer/
+        # donation discipline; psum-axis judges cross-module at finish()
+        RetraceHazardChecker(),
+        HostTransferChecker(),
+        DonationDisciplineChecker(),
+        PsumAxisChecker(),
     ]

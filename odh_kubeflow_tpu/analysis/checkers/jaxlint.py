@@ -1,0 +1,674 @@
+"""jaxlint: the data-plane discipline checkers (ISSUE 12).
+
+Four checkers over the jax-facing modules, catching the silent-perf-killer
+classes that never fail a test — they just move the token-latency SLO:
+
+- ``retrace-hazard``       jit caches remade per call (jit inside a loop,
+                           ``jax.jit(f)(x)``, ``jax.jit(lambda ...)`` in a
+                           function body), non-hashable static arguments,
+                           and shape-derived Python values fed to a static
+                           position (one compile PER DISTINCT VALUE).
+- ``host-transfer``        device->host sync surfaces (``.item()``,
+                           ``jax.device_get``, ``np.array/asarray``,
+                           ``float/int/bool`` over device expressions,
+                           branching on device values) inside a declared
+                           hot region (analysis/hotregions.py) or any
+                           same-module function it reaches.
+- ``donation-discipline``  a jitted fn overwriting a buffer parameter
+                           (``dynamic_update_slice`` / ``.at[...].set``)
+                           without donating it — XLA must then keep both
+                           copies live; and donated arguments read after
+                           the call (they are deleted).
+- ``psum-axis``            collective axis names must be axes some module
+                           actually declares (mesh ``AXES`` tuples,
+                           ``Mesh(..., axis_names=...)``) — a cross-module
+                           finish() pass, since ``parallel/ring_attention``
+                           uses axes ``parallel/mesh`` declares.
+
+The runtime twin is `utils/jaxguard.py`; the two share the hot-region
+registry the way machine-conformance and INVCHECK share `machines.py`.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .. import hotregions
+from ..framework import Checker, Finding, ModuleInfo
+from ._util import dotted_name, terminal_name
+
+_JIT_DOTTED = {"jax.jit", "jit", "jax.pjit", "pjit", "jaxguard.jit"}
+_PARTIAL_DOTTED = {"partial", "functools.partial"}
+
+
+def _as_jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call carrying the jit kwargs if `node` is ``jax.jit(...)`` or
+    ``partial(jax.jit, ...)``; None otherwise."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn in _JIT_DOTTED:
+        return node
+    if dn in _PARTIAL_DOTTED and node.args and dotted_name(node.args[0]) in _JIT_DOTTED:
+        return node
+    return None
+
+
+def _literal_strings(node: ast.AST) -> List[str]:
+    """String constants in `node` (a Constant or a Tuple/List of them)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+        return out
+    return []
+
+
+def _literal_ints(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            elt.value
+            for elt in node.elts
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+        ]
+    return []
+
+
+@dataclass
+class JitSpec:
+    """One in-module jit-decorated function: parameter layout + which
+    positions/names are static and which are donated."""
+
+    fn: ast.FunctionDef
+    params: List[str]
+    static_pos: Set[int] = field(default_factory=set)
+    static_names: Set[str] = field(default_factory=set)
+    donate_pos: Set[int] = field(default_factory=set)
+
+    def static_positions(self) -> Set[int]:
+        out = set(self.static_pos)
+        for name in self.static_names:
+            if name in self.params:
+                out.add(self.params.index(name))
+        return out
+
+
+def _jit_specs(tree: ast.AST) -> Dict[str, JitSpec]:
+    """Terminal name -> JitSpec for every jit-decorated def in the module."""
+    specs: Dict[str, JitSpec] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            call = _as_jit_call(deco)
+            if call is None and dotted_name(deco) not in _JIT_DOTTED:
+                continue
+            spec = JitSpec(
+                fn=node, params=[a.arg for a in node.args.args]
+            )
+            for kw in (call.keywords if call is not None else []):
+                if kw.arg == "static_argnums":
+                    spec.static_pos.update(_literal_ints(kw.value))
+                elif kw.arg == "static_argnames":
+                    spec.static_names.update(_literal_strings(kw.value))
+                elif kw.arg == "donate_argnums":
+                    spec.donate_pos.update(_literal_ints(kw.value))
+                elif kw.arg == "donate_argnames":
+                    for name in _literal_strings(kw.value):
+                        if name in spec.params:
+                            spec.donate_pos.add(spec.params.index(name))
+            specs[node.name] = spec
+            break
+    return specs
+
+
+def _contains_device_call(node: ast.AST) -> bool:
+    """Does the expression contain a jnp./jax./lax. call — i.e. does
+    evaluating it force a device value into a host context?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func)
+            if dn and (
+                dn.startswith("jnp.") or dn.startswith("jax.")
+                or dn.startswith("lax.")
+            ):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# retrace-hazard
+# ---------------------------------------------------------------------------
+
+
+class RetraceHazardChecker(Checker):
+    """Compile-cache hygiene: the cache must be keyed by shapes the caller
+    actually cycles through, and must be MADE exactly once."""
+
+    name = "retrace-hazard"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen_lines: Set[int] = set()
+
+        def flag(line: int, message: str) -> None:
+            if line in seen_lines:
+                return
+            seen_lines.add(line)
+            findings.append(Finding(self.name, module.path, line, message))
+
+        specs = _jit_specs(module.tree)
+
+        # 1. jit created inside a loop body: the callable AND its compile
+        # cache are remade per iteration
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            for sub in ast.walk(node):
+                if sub is node:
+                    continue
+                if _as_jit_call(sub) is not None:
+                    flag(
+                        sub.lineno,
+                        "jax.jit inside a loop body — the jitted callable "
+                        "(and its compile cache) is remade every iteration; "
+                        "hoist it out of the loop",
+                    )
+                elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for deco in sub.decorator_list:
+                        if (
+                            _as_jit_call(deco) is not None
+                            or dotted_name(deco) in _JIT_DOTTED
+                        ):
+                            flag(
+                                sub.lineno,
+                                f"@jax.jit def {sub.name} inside a loop "
+                                "body — a fresh function (and cache) per "
+                                "iteration; define it once outside",
+                            )
+
+        for node in ast.walk(module.tree):
+            call = _as_jit_call(node)
+            if call is None:
+                continue
+            # 2. jax.jit(f)(x): compile cache created and thrown away per call
+            # (walk parents cheaply: look for Call whose func IS this call)
+            # handled below via the parent scan
+            # 3. jit over a lambda inside a function body: fresh callable
+            # identity per invocation of the enclosing function
+            target = call.args[-1] if call.args else None
+            if isinstance(target, ast.Lambda):
+                flag(
+                    call.lineno,
+                    "jax.jit over a lambda — a fresh callable identity "
+                    "(and compile cache) every time this line runs; name "
+                    "the function at module scope",
+                )
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and _as_jit_call(node.func) is not None:
+                flag(
+                    node.lineno,
+                    "jax.jit(...)(args) — the jitted wrapper (and its "
+                    "compile cache) is created per call and never reused; "
+                    "bind the jitted callable once",
+                )
+
+        # 4 + 5: call-site checks against in-module jitted fns
+        for fndef in ast.walk(module.tree):
+            if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            tainted = self._shape_tainted(fndef)
+            for node in ast.walk(fndef):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = terminal_name(node.func)
+                spec = specs.get(callee or "")
+                if spec is None or spec.fn is fndef:
+                    continue
+                static = spec.static_positions()
+                for idx, arg in enumerate(node.args):
+                    if idx in static:
+                        self._check_static_arg(arg, flag, tainted)
+                for kw in node.keywords:
+                    if kw.arg in spec.static_names or (
+                        kw.arg in spec.params
+                        and spec.params.index(kw.arg) in static
+                    ):
+                        self._check_static_arg(kw.value, flag, tainted)
+        return findings
+
+    def _check_static_arg(self, arg: ast.AST, flag, tainted: Set[str]) -> None:
+        if isinstance(arg, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                            ast.DictComp, ast.SetComp)):
+            flag(
+                arg.lineno,
+                "non-hashable value at a static jit position — jax hashes "
+                "static args to key the compile cache; pass a tuple or a "
+                "frozen/hashable config object",
+            )
+            return
+        shape_derived = isinstance(arg, ast.Name) and arg.id in tainted
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call) and terminal_name(sub.func) == "len":
+                shape_derived = True
+            if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                shape_derived = True
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                shape_derived = True
+        if shape_derived:
+            flag(
+                arg.lineno,
+                "shape-derived Python value at a static jit position — one "
+                "compile PER DISTINCT VALUE; pad to a bounded shape family "
+                "or pragma with the rationale if per-shape compiles are "
+                "the design",
+            )
+
+    @staticmethod
+    def _shape_tainted(fndef: ast.AST) -> Set[str]:
+        """Names in `fndef` bound (transitively) from `.shape` / `len()`
+        expressions — the Python-scalar values that retrace per value when
+        fed to a static position."""
+        tainted: Set[str] = set()
+        assigns: List[Tuple[List[str], ast.AST]] = []
+        for node in ast.walk(fndef):
+            if isinstance(node, ast.Assign):
+                # only true Store targets: `self._x[i] = ...` must not taint
+                # `self`/`i` (their ctx is Load inside the subscript)
+                names = [
+                    t.id
+                    for tgt in node.targets
+                    for t in ast.walk(tgt)
+                    if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store)
+                ]
+                assigns.append((names, node.value))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    assigns.append(([node.target.id], node.value))
+        for _ in range(3):  # short transitive closure
+            changed = False
+            for names, value in assigns:
+                hit = False
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+                        hit = True
+                    elif isinstance(sub, ast.Call) and terminal_name(sub.func) == "len":
+                        hit = True
+                    elif isinstance(sub, ast.Name) and sub.id in tainted:
+                        hit = True
+                if hit and not set(names) <= tainted:
+                    tainted.update(names)
+                    changed = True
+            if not changed:
+                break
+        return tainted
+
+
+# ---------------------------------------------------------------------------
+# host-transfer
+# ---------------------------------------------------------------------------
+
+_NP_TRANSFER = {
+    "np.array", "np.asarray", "numpy.array", "numpy.asarray",
+}
+
+
+class HostTransferChecker(Checker):
+    """Device->host sync surfaces inside a declared hot region or any
+    same-module function it reaches. A sync in the decode loop serializes
+    the device pipeline on the host round trip — the per-token dispatch
+    floor continuous batching exists to amortize."""
+
+    name = "host-transfer"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        roots = hotregions.hot_functions_for(module.path)
+        if not roots:
+            return ()
+        funcs = self._module_functions(module.tree)
+        reach = self._reachable(roots, funcs)
+        findings: List[Finding] = []
+        seen_lines: Set[int] = set()
+
+        def flag(line: int, message: str) -> None:
+            if line in seen_lines:
+                return
+            seen_lines.add(line)
+            findings.append(Finding(self.name, module.path, line, message))
+
+        for qualname in sorted(reach):
+            fndef = funcs[qualname]
+            origin = reach[qualname]
+            where = (
+                f"hot region {origin.name!r}"
+                if qualname in roots
+                else f"reached from hot region {origin.name!r}"
+            )
+            for node in ast.walk(fndef):
+                if isinstance(node, ast.Call):
+                    dn = dotted_name(node.func) or ""
+                    tn = terminal_name(node.func) or ""
+                    if tn == "item" and isinstance(node.func, ast.Attribute):
+                        flag(node.lineno,
+                             f".item() in {where} — a blocking device->host "
+                             "sync per call; batch the fetch after the region")
+                    elif tn == "device_get":
+                        flag(node.lineno,
+                             f"jax.device_get in {where} — a blocking host "
+                             "sync; batch into ONE post-region drain "
+                             "(or pragma the intentional one)")
+                    elif dn in _NP_TRANSFER:
+                        flag(node.lineno,
+                             f"{dn} in {where} — materializes the device "
+                             "value on host; keep the value on device or "
+                             "use .copy() on an already-fetched array")
+                    elif tn in ("float", "int", "bool") and node.args and any(
+                        _contains_device_call(a) for a in node.args
+                    ):
+                        flag(node.lineno,
+                             f"{tn}() over a device expression in {where} — "
+                             "an implicit blocking transfer")
+                elif isinstance(node, (ast.If, ast.While)):
+                    if _contains_device_call(node.test):
+                        flag(node.test.lineno,
+                             f"branching on a device value in {where} — "
+                             "implicit bool() is a blocking transfer; fold "
+                             "the predicate into the compiled program (e.g. "
+                             "jnp.where) or fetch it in the batched drain")
+        return findings
+
+    @staticmethod
+    def _module_functions(tree: ast.AST) -> Dict[str, ast.FunctionDef]:
+        """Qualname (`Class.method` / bare fn) -> def node, one level of
+        class nesting (all this codebase has)."""
+        out: Dict[str, ast.FunctionDef] = {}
+        for node in ast.iter_child_nodes(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        out[f"{node.name}.{sub.name}"] = sub
+        return out
+
+    @staticmethod
+    def _reachable(
+        roots: Dict[str, "hotregions.HotRegion"],
+        funcs: Dict[str, ast.FunctionDef],
+    ) -> Dict[str, "hotregions.HotRegion"]:
+        """Roots plus same-module callees reachable from them (edges by
+        terminal call name: `self._emit(...)` reaches `Cls._emit`)."""
+        by_terminal: Dict[str, List[str]] = {}
+        for qualname in funcs:
+            by_terminal.setdefault(qualname.rsplit(".", 1)[-1], []).append(qualname)
+        out: Dict[str, hotregions.HotRegion] = {}
+        work = [
+            (qualname, region)
+            for qualname, region in roots.items()
+            if qualname in funcs
+        ]
+        while work:
+            qualname, region = work.pop()
+            if qualname in out:
+                continue
+            out[qualname] = region
+            for node in ast.walk(funcs[qualname]):
+                if isinstance(node, ast.Call):
+                    tn = terminal_name(node.func)
+                    for callee in by_terminal.get(tn or "", []):
+                        if callee not in out:
+                            work.append((callee, region))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# donation-discipline
+# ---------------------------------------------------------------------------
+
+_AT_MUTATORS = {"set", "add", "multiply", "divide", "min", "max", "mul"}
+
+
+class DonationDisciplineChecker(Checker):
+    """Jitted fns that overwrite a buffer parameter without donating it
+    (XLA keeps both copies live — for a KV cache that's double HBM), and
+    donated arguments read after the call (deleted buffers)."""
+
+    name = "donation-discipline"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        specs = _jit_specs(module.tree)
+        for spec in specs.values():
+            overwritten = self._overwritten_params(spec)
+            for param in sorted(overwritten):
+                pos = spec.params.index(param)
+                if pos not in spec.donate_pos:
+                    findings.append(Finding(
+                        self.name, module.path, spec.fn.lineno,
+                        f"jitted {spec.fn.name!r} overwrites buffer "
+                        f"parameter {param!r} (position {pos}) without "
+                        f"donate_argnums — XLA must keep input AND output "
+                        "copies live; donate the buffer so the update "
+                        "aliases in place",
+                    ))
+        findings.extend(self._reads_after_donation(module, specs))
+        return findings
+
+    @staticmethod
+    def _overwritten_params(spec: JitSpec) -> Set[str]:
+        """Params whose (transitively-derived) values are written via
+        dynamic_update_slice / .at[...].set inside the function body.
+        Propagation covers assignments and for-loop unpacking over
+        zip/enumerate of tainted values — the per-layer cache idiom."""
+        origins: Dict[str, Set[str]] = {p: {p} for p in spec.params}
+
+        def expr_origins(node: ast.AST) -> Set[str]:
+            out: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id in origins:
+                    out |= origins[sub.id]
+            return out
+
+        def bind(targets: Sequence[ast.AST], value: ast.AST) -> bool:
+            src = expr_origins(value)
+            if not src:
+                return False
+            changed = False
+            for tgt in targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name):
+                        if not src <= origins.get(t.id, set()):
+                            origins[t.id] = origins.get(t.id, set()) | src
+                            changed = True
+            return changed
+
+        body_nodes = [
+            n for n in ast.walk(spec.fn)
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            or n is spec.fn
+        ]
+        for _ in range(3):
+            changed = False
+            for node in body_nodes:
+                if isinstance(node, ast.Assign):
+                    changed |= bind(node.targets, node.value)
+                elif isinstance(node, ast.For):
+                    changed |= bind([node.target], node.iter)
+            if not changed:
+                break
+
+        overwritten: Set[str] = set()
+        for node in body_nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            tn = terminal_name(node.func)
+            if tn == "dynamic_update_slice" and node.args:
+                overwritten |= expr_origins(node.args[0])
+            elif (
+                tn in _AT_MUTATORS
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Subscript)
+                and isinstance(node.func.value.value, ast.Attribute)
+                and node.func.value.value.attr == "at"
+            ):
+                overwritten |= expr_origins(node.func.value.value.value)
+        return overwritten & set(spec.params)
+
+    def _reads_after_donation(
+        self, module: ModuleInfo, specs: Dict[str, JitSpec]
+    ) -> List[Finding]:
+        findings: List[Finding] = []
+        for fndef in ast.walk(module.tree):
+            if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fndef):
+                if not isinstance(node, ast.Call):
+                    continue
+                spec = specs.get(terminal_name(node.func) or "")
+                if spec is None or not spec.donate_pos or spec.fn is fndef:
+                    continue
+                for pos in sorted(spec.donate_pos):
+                    if pos >= len(node.args):
+                        continue
+                    donated = dotted_name(node.args[pos])
+                    if donated is None:
+                        continue
+                    line = self._read_after(fndef, node, donated)
+                    if line is not None:
+                        findings.append(Finding(
+                            self.name, module.path, line,
+                            f"{donated!r} is read after being donated to "
+                            f"{spec.fn.name!r} (position {pos}) — the "
+                            "buffer is deleted by the call; rebind the "
+                            "result or stop donating",
+                        ))
+        return findings
+
+    @staticmethod
+    def _read_after(
+        fndef: ast.AST, call: ast.Call, donated: str
+    ) -> Optional[int]:
+        """Line of the first Load of `donated` after the donating call,
+        unless the name is rebound first (including by the call's own
+        enclosing assignment)."""
+        call_end = getattr(call, "end_lineno", call.lineno)
+        first_load: Optional[int] = None
+        first_store: Optional[int] = None
+        for node in ast.walk(fndef):
+            dn = dotted_name(node) if isinstance(node, (ast.Name, ast.Attribute)) else None
+            if dn != donated:
+                continue
+            ctx = getattr(node, "ctx", None)
+            if isinstance(ctx, ast.Store):
+                # the donating call's own assignment target rebinds on the
+                # statement line(s) the call spans
+                lineno = node.lineno
+                if lineno >= call.lineno and (
+                    first_store is None or lineno < first_store
+                ):
+                    first_store = lineno
+            elif isinstance(ctx, ast.Load) and node.lineno > call_end:
+                if first_load is None or node.lineno < first_load:
+                    first_load = node.lineno
+        if first_load is None:
+            return None
+        if first_store is not None and first_store <= first_load:
+            return None
+        return first_load
+
+
+# ---------------------------------------------------------------------------
+# psum-axis
+# ---------------------------------------------------------------------------
+
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter", "axis_index", "axis_size",
+}
+_AXES_ASSIGN_NAMES = {"AXES", "MESH_AXES", "axis_names"}
+
+
+class PsumAxisChecker(Checker):
+    """Collective axis-name literals must be axes some scanned module
+    declares (mesh AXES tuples / Mesh(axis_names=...)). Cross-module: uses
+    are collected per module, judged once at finish() against the union of
+    declared axes — `ring_attention`'s "sp" default is legal because
+    `parallel/mesh.py` declares it."""
+
+    name = "psum-axis"
+
+    def __init__(self) -> None:
+        self.declared: Set[str] = set()
+        self.uses: List[Tuple[str, int, str]] = []  # (path, line, axis)
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and tgt.id in _AXES_ASSIGN_NAMES
+                    ):
+                        self.declared.update(_literal_strings(node.value))
+            elif isinstance(node, ast.Call):
+                tn = terminal_name(node.func) or ""
+                if tn == "Mesh" or tn == "make_mesh":
+                    if len(node.args) >= 2:
+                        self.declared.update(_literal_strings(node.args[1]))
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        self.declared.update(_literal_strings(kw.value))
+                if tn in _COLLECTIVES:
+                    for arg in node.args[1:] if tn not in (
+                        "axis_index", "axis_size"
+                    ) else node.args:
+                        for axis in _literal_strings(arg):
+                            self.uses.append((module.path, arg.lineno, axis))
+                    for kw in node.keywords:
+                        if kw.arg in ("axis_name", "axis", "axis_names"):
+                            for axis in _literal_strings(kw.value):
+                                self.uses.append(
+                                    (module.path, kw.value.lineno, axis)
+                                )
+                elif tn in ("pmap", "shard_map", "xmap"):
+                    for kw in node.keywords:
+                        if kw.arg in ("axis_name", "axis_names"):
+                            for axis in _literal_strings(kw.value):
+                                self.uses.append(
+                                    (module.path, kw.value.lineno, axis)
+                                )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = node.args
+                defaults = args.defaults
+                params = args.args[len(args.args) - len(defaults):]
+                for param, default in zip(params, defaults):
+                    if param.arg in ("axis_name", "axis_names"):
+                        for axis in _literal_strings(default):
+                            self.uses.append(
+                                (module.path, default.lineno, axis)
+                            )
+        return ()
+
+    def finish(self) -> Iterable[Finding]:
+        if not self.declared:
+            # nothing in the scanned tree declares mesh axes (fixture runs
+            # over non-parallel modules): no basis to judge uses
+            return ()
+        findings = []
+        for path, line, axis in self.uses:
+            if axis not in self.declared:
+                findings.append(Finding(
+                    self.name, path, line,
+                    f"collective axis {axis!r} is not a declared mesh axis "
+                    f"(declared: {sorted(self.declared)}) — the collective "
+                    "would fail (or silently no-op under a 1-sized rename) "
+                    "at the call site's mesh",
+                ))
+        return findings

@@ -14,6 +14,11 @@
                                     # machine specs (ARCHITECTURE round 9)
     python -m odh_kubeflow_tpu.analysis --explore             # bounded
                                     # exhaustive interleaving run (ISSUE 8)
+    python -m odh_kubeflow_tpu.analysis --check retrace-hazard \
+        --check host-transfer --check donation-discipline \
+        --check psum-axis odh_kubeflow_tpu
+                                    # the jaxlint data-plane family
+                                    # (ci/analysis.sh --jax lane, ISSUE 12)
 
 Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
 """

@@ -1,0 +1,130 @@
+"""Hot-region registry: the data-plane regions where a host sync or a
+retrace is an SLO bug, not a style nit (ISSUE 12).
+
+One table, two consumers:
+
+- the STATIC half (`checkers/jaxlint.py` host-transfer) treats the listed
+  functions as roots and flags any host-transfer surface (`.item()`,
+  `jax.device_get`, `np.array`, implicit bool on device values) inside them
+  or inside same-module callees they reach;
+- the RUNTIME half (`utils/jaxguard.py`) looks the region up by name when a
+  `jaxguard.region(...)` context is armed, and enforces the declared
+  budgets: `compile_budget` caps traces of guarded jits attributed to the
+  region over one region object's lifetime, `transfer_budget` caps
+  `jax.device_get` calls PER ENTRY (each `with region:` resets it).
+
+The budgets are the contract ARCHITECTURE.md round 12 records: a region's
+budget is the number the bench asserts and the number a ROADMAP-item-3
+regression has to argue with. `None` means "unbudgeted by design" (e.g.
+prefill compiles once per distinct prompt length — that IS the design; the
+guard still counts so the bench can report it).
+
+Declaring a new hot region is two lines here plus the `with` block at the
+call site — the registry stays import-light (stdlib only) because the
+static checker runs in bare environments without jax.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class HotRegion:
+    """One declared hot region.
+
+    `module` is a repo-relative path suffix (matched with endswith so
+    fixture tests and installed-package scans both resolve); `functions`
+    are the root qualnames (`Class.method` or bare function) the static
+    host-transfer checker starts its same-module reachability walk from.
+    """
+
+    name: str
+    module: str
+    functions: Tuple[str, ...]
+    # max traces of guarded jits attributed to one region consumer's
+    # lifetime; None = unbudgeted (counted, reported, never fatal)
+    compile_budget: Optional[int]
+    # max jax.device_get calls per region ENTRY; None = unbudgeted
+    transfer_budget: Optional[int]
+    rationale: str
+
+
+REGIONS: Tuple[HotRegion, ...] = (
+    HotRegion(
+        name="serving.decode_burst",
+        module="odh_kubeflow_tpu/serving/engine.py",
+        functions=("ServingEngine.step",),
+        # the burst program itself plus ONE spare trace for a deliberate
+        # shape migration (cache growth / burst retune on a live engine);
+        # a third trace is a retrace leak and fails the region exit
+        compile_budget=2,
+        # steady state is ZERO in-region transfers: the one intentional
+        # post-burst drain happens AFTER the region closes (one
+        # device_get per burst, asserted separately via transfer_count)
+        transfer_budget=0,
+        rationale="a decode burst is one dispatch; any in-burst host sync "
+        "or retrace multiplies per-token latency by the tunnel floor",
+    ),
+    HotRegion(
+        name="serving.prefill",
+        module="odh_kubeflow_tpu/serving/engine.py",
+        functions=("ServingEngine._admit",),
+        # one compiled program per distinct prompt length is the DESIGN
+        # (_prefill_jit docstring) — counted for stats, never fatal
+        compile_budget=None,
+        # exactly one budgeted transfer: the first-token argmax fetch
+        # that makes TTFT independent of the decode batch
+        transfer_budget=1,
+        rationale="admission runs between bursts; a second host sync here "
+        "stalls every active slot, not just the admitted request",
+    ),
+    HotRegion(
+        name="models.generate",
+        module="odh_kubeflow_tpu/models/decode.py",
+        functions=("generate",),
+        # compiles once per (prompt shape, max_new, sample) by design —
+        # the whole generate call is ONE program; counted for stats
+        compile_budget=None,
+        transfer_budget=0,
+        rationale="generate() is one compiled program per shape; a host "
+        "sync inside it would reintroduce the per-token dispatch floor",
+    ),
+    HotRegion(
+        name="bench.train_step",
+        module="bench.py",
+        functions=(),
+        # the train step compiles exactly once; a second trace means the
+        # step function closed over something shape-varying
+        compile_budget=1,
+        transfer_budget=None,
+        rationale="bench_train_step's two-length slope assumes one "
+        "compiled program; a retrace poisons the timing math",
+    ),
+)
+
+_BY_NAME: Dict[str, HotRegion] = {r.name: r for r in REGIONS}
+
+
+def get(name: str) -> HotRegion:
+    """Look a region up by name — unknown names raise so a typo'd guard
+    cannot silently run unbudgeted."""
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown hot region {name!r} — declare it in "
+            f"analysis/hotregions.py (known: {sorted(_BY_NAME)})"
+        ) from None
+
+
+def hot_functions_for(path: str) -> Dict[str, HotRegion]:
+    """Root qualname -> region for every region whose module matches
+    `path` (endswith, so cwd-relative and absolute paths both hit). The
+    static host-transfer checker's entry point."""
+    out: Dict[str, HotRegion] = {}
+    for region in REGIONS:
+        if path.endswith(region.module):
+            for fn in region.functions:
+                out[fn] = region
+    return out

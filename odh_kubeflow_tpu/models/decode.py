@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops import rms_norm
+from ..utils import jaxguard
 from .transformer import (
     TransformerConfig,
     layer_post_attention,
@@ -275,7 +276,8 @@ def _cache_constrainer(cfg: TransformerConfig, mesh):
     return lambda t: lax.with_sharding_constraint(t, sh)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new", "max_seq", "sample", "mesh"))
+@partial(jaxguard.jit, region="models.generate",
+         static_argnames=("cfg", "max_new", "max_seq", "sample", "mesh"))
 def _generate_impl(params, prompt, rng, temperature, cfg, max_new, max_seq, sample,
                    mesh=None):
     b, s = prompt.shape
@@ -376,7 +378,11 @@ def generate(
         jnp.asarray(temperature, jnp.float32),
         cfg,
         max_new,
-        max_seq,
+        # one compiled program PER (prompt shape, max_new, max_seq) is the
+        # generate() contract — the whole prefill+decode loop is one
+        # static-shaped program (module docstring); callers with unbounded
+        # shape families go through the serving engine instead
+        max_seq,  # lint: disable=retrace-hazard
         sample,
         mesh,
     )

@@ -53,7 +53,7 @@ from ..models.decode import NEG_INF, _finish_layer, prefill
 from ..models.transformer import TransformerConfig, layer_qkv
 from ..ops import rms_norm
 from ..tpu import telemetry
-from ..utils import racecheck
+from ..utils import jaxguard, racecheck
 from ..utils.tracing import record_span
 from . import metrics as M
 
@@ -103,7 +103,8 @@ def _slot_attention(q, k_cache, v_cache, valid, cfg: TransformerConfig):
     return attn.reshape(b, 1, cfg.n_heads, cfg.head_dim)
 
 
-@partial(jax.jit, static_argnames=("cfg", "burst"), donate_argnums=(1,))
+@partial(jaxguard.jit, region="serving.decode_burst",
+         static_argnames=("cfg", "burst"), donate_argnums=(1,))
 def _decode_burst(params, caches, layers, lengths, tokens, remaining, eos,
                   cfg, burst):
     """`burst` decode steps for every slot in ONE compiled program — the
@@ -171,7 +172,8 @@ def _decode_burst(params, caches, layers, lengths, tokens, remaining, eos,
     return caches, lengths, tokens, remaining, toks, actives
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_seq"))
+@partial(jaxguard.jit, region="serving.prefill",
+         static_argnames=("cfg", "max_seq"))
 def _prefill_jit(params, tokens, cfg, max_seq):
     """One compiled program per distinct prompt length (decode.py's prefill
     is deliberately un-jitted — generate() jits around it; an engine
@@ -180,7 +182,7 @@ def _prefill_jit(params, tokens, cfg, max_seq):
     return prefill(params, tokens, cfg, max_seq)
 
 
-@partial(jax.jit, donate_argnums=(0,))
+@partial(jaxguard.jit, region="serving.prefill", donate_argnums=(0,))
 def _insert_slot(caches, ck, cv, slot):
     """Land a prefilled sequence's K/V (stacked (L, 1, max_seq, kv, hd)
     from prefill()) into cache slot `slot` of every per-layer buffer. The
@@ -259,6 +261,19 @@ class ServingEngine:
         self._generated_total = 0
         self._decode_steps = 0
         self._busy_s = 0.0
+        # JAXGUARD (ISSUE 12): persistent per-engine guarded regions — the
+        # compile budget is judged per CONSUMER (this engine), and the
+        # transfer guard arms per entry. No-ops unless JAXGUARD=1.
+        self._burst_guard = jaxguard.region("serving.decode_burst")
+        self._prefill_guard = jaxguard.region("serving.prefill")
+        # compile counters are process-global and monotonic (the jit cache
+        # is module-level, shared across engines): snapshot at construction
+        # so stats() reports compiles SINCE this engine existed
+        self._compile_base = {
+            name: jaxguard.compile_count(name)
+            for name in ("serving.decode_burst", "serving.prefill")
+        }
+        self._host_transfers_last_burst = 0
 
     # ---------- submission ----------
 
@@ -308,28 +323,38 @@ class ServingEngine:
             return bool(admitted)
         burst = self.decode_burst
         t0 = self.clock()
-        (
-            self._caches, lengths, tokens, remaining, toks, actives
-        ) = _decode_burst(
-            self.params,
-            self._caches,
-            self._layers,
-            jnp.asarray(self._lengths),
-            jnp.asarray(self._tokens),
-            jnp.asarray(self._remaining),
-            jnp.asarray(
-                self.eos_id if self.eos_id is not None else -1, jnp.int32
-            ),
-            self.cfg,
-            burst,
+        transfers_before = jaxguard.transfer_count()
+        with self._burst_guard:
+            (
+                self._caches, lengths, tokens, remaining, toks, actives
+            ) = _decode_burst(
+                self.params,
+                self._caches,
+                self._layers,
+                jnp.asarray(self._lengths),
+                jnp.asarray(self._tokens),
+                jnp.asarray(self._remaining),
+                jnp.asarray(
+                    self.eos_id if self.eos_id is not None else -1, jnp.int32
+                ),
+                self.cfg,
+                burst,
+            )
+        # the intentional post-burst drain: every per-slot output of the
+        # burst in ONE host sync (was five — a 5x on the tunnel round-trip
+        # floor per burst; see BENCH serving delta). Outside the guarded
+        # region by design: the burst itself holds transfer budget 0.
+        lengths, tokens, remaining, toks, actives = jax.device_get(  # lint: disable=host-transfer
+            (lengths, tokens, remaining, toks, actives)
         )
-        # np.array (copy): device_get hands back read-only views, and the
+        # .copy(): device_get hands back read-only views, and the
         # admission path writes these slots in place
-        self._lengths = np.array(jax.device_get(lengths))
-        self._tokens = np.array(jax.device_get(tokens))
-        self._remaining = np.array(jax.device_get(remaining))
-        toks = np.asarray(jax.device_get(toks))
-        actives = np.asarray(jax.device_get(actives))
+        self._lengths = lengths.copy()
+        self._tokens = tokens.copy()
+        self._remaining = remaining.copy()
+        self._host_transfers_last_burst = (
+            jaxguard.transfer_count() - transfers_before
+        )
         now = self.clock()
         burst_dt = now - t0
         self._busy_s += burst_dt
@@ -362,13 +387,18 @@ class ServingEngine:
                 handle = self._queue.popleft()
                 M.inference_queue_depth.set(float(len(self._queue)))
             prompt = jnp.asarray([handle.prompt], jnp.int32)
-            logits, cache = _prefill_jit(
-                self.params, prompt, self.cfg, self.max_seq
-            )
-            self._caches = _insert_slot(
-                self._caches, cache.k, cache.v, jnp.asarray(free, jnp.int32)
-            )
-            first = int(jax.device_get(jnp.argmax(logits, axis=-1))[0])
+            with self._prefill_guard:
+                logits, cache = _prefill_jit(
+                    self.params, prompt, self.cfg, self.max_seq
+                )
+                self._caches = _insert_slot(
+                    self._caches, cache.k, cache.v,
+                    jnp.asarray(free, jnp.int32),
+                )
+                # the ONE budgeted transfer per admission (hotregions.py:
+                # serving.prefill transfer_budget=1): TTFT requires the
+                # first token now, not at the next burst boundary
+                first = int(jax.device_get(jnp.argmax(logits, axis=-1))[0])  # lint: disable=host-transfer
             now = self.clock()
             handle.ttft_s = now - handle.submitted
             M.inference_ttft_seconds.observe(handle.ttft_s)
@@ -500,4 +530,20 @@ class ServingEngine:
             "generated_tokens": self._generated_total,
             "decode_steps": self._decode_steps,
             "busy_s": round(self._busy_s, 6),
+            # traces of the guarded jits since THIS engine was built (the
+            # module-level jit cache is shared: a second engine with the
+            # same shapes legitimately reports 0). bench.py asserts these
+            # against the hotregions.py budgets.
+            "decode_burst_recompiles": (
+                jaxguard.compile_count("serving.decode_burst")
+                - self._compile_base["serving.decode_burst"]
+            ),
+            "prefill_recompiles": (
+                jaxguard.compile_count("serving.prefill")
+                - self._compile_base["serving.prefill"]
+            ),
+            # device_gets observed during the last step() (0 unless the
+            # JAXGUARD shim is installed): steady state is exactly 1 — the
+            # batched post-burst drain
+            "host_transfers_last_burst": self._host_transfers_last_burst,
         }

@@ -1,0 +1,290 @@
+"""JAXGUARD=1 — opt-in compilation/transfer/donation guard for the data
+plane (RACECHECK/INVCHECK's third sibling, ISSUE 12).
+
+The static half (`analysis/checkers/jaxlint.py`) proves the SOURCE carries
+no retrace hazard, hot-loop host sync, or missed donation; this module
+proves the PROCESS doesn't either — the two share the hot-region registry
+(`analysis/hotregions.py`) the same way machine-conformance and INVCHECK
+share `machines.py`:
+
+1. **Compile-count budget** (`jaxguard.jit`): the python callable is
+   wrapped so its body — which jax executes only while (re)tracing — bumps
+   a per-region compile counter before `jax.jit` sees it. Counting is
+   therefore FREE at steady state (the wrapper body never runs on a cache
+   hit) and stays on even when the guard is off, so `bench.py` can mine
+   `decode_burst_recompiles`/`train_step_recompiles` from any run. An armed
+   `region(...)` context checks its consumer-local count against the
+   registry's `compile_budget` at exit and raises `CompileBudgetError` on a
+   retrace leak — per CONSUMER, so two engines with different configs each
+   get their own budget instead of poisoning a global counter.
+
+2. **Transfer guard** (`region(...)`): the first armed region entry swaps
+   `jax.device_get` for a counting shim. Inside an armed region each entry
+   gets `transfer_budget` device_gets (0 for the decode burst: steady state
+   is ZERO in-region syncs); the budget-exceeding call raises
+   `HostTransferError` BEFORE fetching, so the traceback's innermost user
+   frame is the exact offending line. `allow_transfer()` is the runtime
+   twin of the `# lint: disable=host-transfer` pragma — an audited escape
+   hatch for the intentional sync. The shim counts globally even outside
+   regions, so the engine can report host transfers per burst.
+
+3. **Donation audit** (`jaxguard.jit` with `donate_argnums`): after each
+   guarded call the donated pytree leaves are checked with
+   `jax.Array.is_deleted()` — XLA deletes a donated input iff it actually
+   aliased an output buffer, so a silently-IGNORED donation (wrong layout,
+   proxy backend, incompatible shape) surfaces as `DonationError` instead
+   of as doubled HBM that only shows up in an OOM three PRs later.
+
+Zero-cost when off: `jaxguard.jit` adds one `enabled()` check per dispatch
+(and nothing at all per trace-cache hit inside jax), `region` returns
+before touching any state, and the device_get shim is never installed.
+`ci/faults.sh` runs one JAXGUARD=1 iteration in the serving and job lanes
+so every fault soak doubles as a compilation-discipline run.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..analysis import hotregions
+
+
+def enabled() -> bool:
+    return os.environ.get("JAXGUARD", "") not in ("", "0", "false")
+
+
+class CompileBudgetError(RuntimeError):
+    """A guarded jit retraced past its region's declared compile budget."""
+
+
+class HostTransferError(RuntimeError):
+    """A device->host transfer inside an armed guarded region exceeded the
+    region's per-entry transfer budget."""
+
+
+class DonationError(RuntimeError):
+    """A donated buffer was silently NOT aliased by the runtime — the
+    caller is paying for two copies of a buffer it meant to recycle."""
+
+
+# ---------------------------------------------------------------------------
+# counters + the active-region stack
+# ---------------------------------------------------------------------------
+
+_mu = threading.Lock()
+_compiles: Dict[str, int] = {}  # region name -> total traces (stats)
+_transfers = 0  # total device_gets through the shim
+_tls = threading.local()
+
+
+def _region_stack() -> List["region"]:
+    stack = getattr(_tls, "regions", None)
+    if stack is None:
+        stack = _tls.regions = []
+    return stack
+
+
+def compile_count(name: str) -> int:
+    """Total traces attributed to `name` since process start (monotonic —
+    consumers snapshot and diff; see ServingEngine.stats())."""
+    with _mu:
+        return _compiles.get(name, 0)
+
+
+def transfer_count() -> int:
+    """Total `jax.device_get` calls observed by the shim (0 until the
+    first armed region installs it)."""
+    return _transfers
+
+
+def reset() -> None:
+    """Clear counters (test isolation). Does NOT uninstall the shim or
+    forget active regions — those belong to their owners."""
+    global _transfers
+    with _mu:
+        _compiles.clear()
+    _transfers = 0
+
+
+def _on_trace(name: Optional[str]) -> None:
+    """Runs inside the traced wrapper body — i.e. only while jax is
+    (re)tracing the guarded callable. Attributes the trace to the region
+    name globally and to the innermost active region object on this
+    thread (the per-consumer budget count)."""
+    if name is not None:
+        with _mu:
+            _compiles[name] = _compiles.get(name, 0) + 1
+    stack = _region_stack()
+    if stack:
+        stack[-1]._compiles_seen += 1
+
+
+# ---------------------------------------------------------------------------
+# the device_get shim
+# ---------------------------------------------------------------------------
+
+_orig_device_get: Optional[Callable[..., Any]] = None
+
+
+def _shimmed_device_get(*args: Any, **kwargs: Any) -> Any:
+    global _transfers
+    _transfers += 1
+    stack = _region_stack()
+    if stack and not getattr(_tls, "allow_depth", 0):
+        top = stack[-1]
+        top._entry_transfers += 1
+        budget = top.spec.transfer_budget
+        if budget is not None and top._entry_transfers > budget:
+            # raise BEFORE fetching: the innermost user frame in the
+            # traceback is the offending device_get call site
+            raise HostTransferError(
+                f"jax.device_get inside guarded region {top.name!r}: "
+                f"{top._entry_transfers} transfer(s) this entry, budget "
+                f"{budget} (analysis/hotregions.py) — hoist the fetch out "
+                f"of the region, batch it into the post-region drain, or "
+                f"wrap an audited exception in jaxguard.allow_transfer()"
+            )
+    assert _orig_device_get is not None
+    return _orig_device_get(*args, **kwargs)
+
+
+def _install_shim() -> None:
+    global _orig_device_get
+    import jax
+
+    with _mu:
+        if _orig_device_get is None:
+            _orig_device_get = jax.device_get
+            jax.device_get = _shimmed_device_get
+
+
+class allow_transfer:
+    """Context manager: device_gets inside do not count against the
+    enclosing region's budget — the runtime twin of the
+    `# lint: disable=host-transfer` pragma. Keep the justification comment
+    next to the `with`, same as the static pragma."""
+
+    def __enter__(self) -> "allow_transfer":
+        _tls.allow_depth = getattr(_tls, "allow_depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _tls.allow_depth -= 1
+
+
+# ---------------------------------------------------------------------------
+# guarded jit
+# ---------------------------------------------------------------------------
+
+
+def _donated_positions(jit_kwargs: Dict[str, Any]) -> Tuple[int, ...]:
+    donate = jit_kwargs.get("donate_argnums", ())
+    if isinstance(donate, int):
+        donate = (donate,)
+    return tuple(donate)
+
+
+def jit(fn: Optional[Callable[..., Any]] = None, *, region: str,
+        **jit_kwargs: Any) -> Callable[..., Any]:
+    """`jax.jit` with a compile counter attributed to `region` (always on —
+    the counter lives in the traced body, so steady-state calls never see
+    it) and, under JAXGUARD=1, a donation audit on every call that donates.
+
+    `region` must be declared in analysis/hotregions.py — the same names
+    the `region(...)` runtime context and the bench counters use."""
+    if fn is None:
+        return functools.partial(jit, region=region, **jit_kwargs)
+    hotregions.get(region)  # typo'd names fail at decoration time
+    import jax
+
+    @functools.wraps(fn)
+    def traced(*args: Any, **kwargs: Any) -> Any:
+        _on_trace(region)
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(traced, **jit_kwargs)
+    donate = _donated_positions(jit_kwargs)
+    if not donate:
+        return jitted
+
+    @functools.wraps(fn)
+    def call(*args: Any, **kwargs: Any) -> Any:
+        if not enabled():
+            return jitted(*args, **kwargs)
+        leaves = [
+            leaf
+            for pos in donate
+            if pos < len(args)
+            for leaf in jax.tree_util.tree_leaves(args[pos])
+            if isinstance(leaf, jax.Array)
+        ]
+        out = jitted(*args, **kwargs)
+        survivors = sum(1 for leaf in leaves if not leaf.is_deleted())
+        if survivors:
+            raise DonationError(
+                f"{getattr(fn, '__name__', fn)!r} (region {region!r}): "
+                f"{survivors}/{len(leaves)} donated buffer(s) were NOT "
+                f"aliased — the runtime silently ignored the donation "
+                f"(layout/shape mismatch or a backend that can't alias), "
+                f"so the caller is holding two live copies"
+            )
+        return out
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# guarded regions
+# ---------------------------------------------------------------------------
+
+
+class region:
+    """A reusable, re-enterable guarded region bound to a hot-region
+    declaration. Hold ONE instance per consumer (e.g. the engine keeps
+    `self._burst_guard` for its lifetime) so the compile budget is judged
+    per consumer, not against every other engine in the process.
+
+    No-op when JAXGUARD is unset: `__enter__` checks `enabled()` and
+    returns immediately — zero state touched on the production path."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.spec = hotregions.get(name)
+        self._compiles_seen = 0  # traces attributed while this is innermost
+        self._entry_transfers = 0
+        self._armed = False
+
+    @property
+    def compiles(self) -> int:
+        """Traces attributed to this consumer while armed."""
+        return self._compiles_seen
+
+    def __enter__(self) -> "region":
+        if not enabled():
+            return self
+        self._armed = True
+        _install_shim()
+        self._entry_transfers = 0
+        _region_stack().append(self)
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
+        if not self._armed:
+            return
+        self._armed = False
+        stack = _region_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is not None:
+            return  # don't shadow the in-region failure
+        budget = self.spec.compile_budget
+        if budget is not None and self._compiles_seen > budget:
+            raise CompileBudgetError(
+                f"guarded region {self.name!r} has traced "
+                f"{self._compiles_seen} time(s), compile budget {budget} "
+                f"(analysis/hotregions.py) — a guarded jit is retracing at "
+                f"steady state (shape-varying arg not marked static, or a "
+                f"static arg varying per call)"
+            )

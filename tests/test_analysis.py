@@ -27,6 +27,12 @@ from odh_kubeflow_tpu.analysis.checkers.lock_discipline import (
     LockDisciplineChecker,
     LockOrderChecker,
 )
+from odh_kubeflow_tpu.analysis.checkers.jaxlint import (
+    DonationDisciplineChecker,
+    HostTransferChecker,
+    PsumAxisChecker,
+    RetraceHazardChecker,
+)
 from odh_kubeflow_tpu.analysis.checkers.machine_conformance import (
     MachineConformanceChecker,
 )
@@ -639,6 +645,277 @@ def test_pragma_inside_string_literal_is_inert():
     )
     findings = run_on_source(src, [SwallowedExceptionChecker()])
     assert checks_of(findings) == {"swallowed-exception"}
+
+
+# ---------------------------------------------------------------------------
+# jaxlint (ISSUE 12): retrace-hazard
+# ---------------------------------------------------------------------------
+
+RETRACE_LOOP_BAD = '''
+import jax
+
+def run(fs, xs):
+    for f in fs:
+        g = jax.jit(f)
+        g(xs)
+'''
+
+RETRACE_IIFE_BAD = '''
+import jax
+
+def call(f, x):
+    return jax.jit(f)(x)
+'''
+
+RETRACE_LAMBDA_BAD = '''
+import jax
+
+def call(x):
+    f = jax.jit(lambda t: t + 1)
+    return f(x)
+'''
+
+RETRACE_STATIC_BAD = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x[:n]
+
+@partial(jax.jit, static_argnums=(1,))
+def g(x, opts):
+    return x
+
+def caller(x):
+    n = len(x)
+    out = f(x, n)
+    return g(out, [1, 2])
+'''
+
+RETRACE_CLEAN = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x[:n]
+
+def caller(x):
+    return f(x, 4)
+'''
+
+
+def test_retrace_hazard_flags_jit_in_loop():
+    findings = run_on_source(RETRACE_LOOP_BAD, [RetraceHazardChecker()])
+    assert checks_of(findings) == {"retrace-hazard"}
+    assert any("loop" in f.message for f in findings)
+
+
+def test_retrace_hazard_flags_immediately_invoked_jit():
+    findings = run_on_source(RETRACE_IIFE_BAD, [RetraceHazardChecker()])
+    assert checks_of(findings) == {"retrace-hazard"}
+    assert any("per call" in f.message for f in findings)
+
+
+def test_retrace_hazard_flags_jit_over_lambda():
+    findings = run_on_source(RETRACE_LAMBDA_BAD, [RetraceHazardChecker()])
+    assert checks_of(findings) == {"retrace-hazard"}
+    assert any("lambda" in f.message for f in findings)
+
+
+def test_retrace_hazard_flags_static_arg_hazards():
+    findings = run_on_source(RETRACE_STATIC_BAD, [RetraceHazardChecker()])
+    assert checks_of(findings) == {"retrace-hazard"}
+    messages = " | ".join(f.message for f in findings)
+    assert "shape-derived" in messages  # len(x) fed to static n
+    assert "non-hashable" in messages  # [1, 2] fed to static opts
+
+
+def test_retrace_hazard_passes_clean_twin():
+    assert run_on_source(RETRACE_CLEAN, [RetraceHazardChecker()]) == []
+
+
+# ---------------------------------------------------------------------------
+# jaxlint: host-transfer (hot regions from analysis/hotregions.py)
+# ---------------------------------------------------------------------------
+
+ENGINE_PATH = "odh_kubeflow_tpu/serving/engine.py"
+
+HOST_TRANSFER_BAD = '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+class ServingEngine:
+    def step(self):
+        v = self._latest()
+        if jnp.sum(v) > 0:
+            pass
+        return v.item()
+
+    def _latest(self):
+        x = jax.device_get(self._buf)
+        return np.asarray(x)
+'''
+
+HOST_TRANSFER_CLEAN = '''
+import jax
+
+class ServingEngine:
+    def step(self):
+        out = self._burst()
+        drained = jax.device_get(out)  # lint: disable=host-transfer
+        return drained
+
+    def _burst(self):
+        return self._fn(self._buf)
+
+class Reporter:
+    def outside_hot_region(self):
+        return float(jax.device_get(self._x)[0])
+'''
+
+
+def test_host_transfer_flags_sync_surfaces_in_hot_region():
+    findings = run_on_source(
+        HOST_TRANSFER_BAD, [HostTransferChecker()], path=ENGINE_PATH
+    )
+    assert checks_of(findings) == {"host-transfer"}
+    messages = " | ".join(f.message for f in findings)
+    assert ".item()" in messages
+    assert "device_get" in messages  # in _latest, REACHED from step
+    assert "np.asarray" in messages
+    assert "branching on a device value" in messages
+
+
+def test_host_transfer_pragma_and_reachability_scope():
+    # the pragma'd intentional drain is suppressed; Reporter is not
+    # reachable from any declared hot root, so its transfer is legal
+    assert run_on_source(
+        HOST_TRANSFER_CLEAN, [HostTransferChecker()], path=ENGINE_PATH
+    ) == []
+
+
+def test_host_transfer_silent_outside_registered_modules():
+    # same ugly source, but the module is not a registered hot region
+    assert run_on_source(
+        HOST_TRANSFER_BAD, [HostTransferChecker()], path="odh/other.py"
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# jaxlint: donation-discipline
+# ---------------------------------------------------------------------------
+
+DONATION_MISSING_BAD = '''
+import jax
+from jax import lax
+
+@jax.jit
+def write(cache, new):
+    for buf in cache:
+        buf = lax.dynamic_update_slice(buf, new, (0, 0))
+    return cache
+'''
+
+DONATION_READ_AFTER_BAD = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, x):
+    return state + x
+
+def loop(state, xs):
+    out = step(state, xs)
+    return state + out
+'''
+
+DONATION_CLEAN = '''
+import jax
+from functools import partial
+from jax import lax
+
+@partial(jax.jit, donate_argnums=(0,))
+def write(cache, new):
+    out = []
+    for buf in cache:
+        out.append(lax.dynamic_update_slice(buf, new, (0, 0)))
+    return out
+
+def loop(state, xs):
+    state = step(state, xs)
+    return state
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, x):
+    return state + x
+'''
+
+
+def test_donation_discipline_flags_update_without_donation():
+    findings = run_on_source(DONATION_MISSING_BAD, [DonationDisciplineChecker()])
+    assert checks_of(findings) == {"donation-discipline"}
+    assert any("without donate_argnums" in f.message for f in findings)
+
+
+def test_donation_discipline_flags_read_after_donation():
+    findings = run_on_source(DONATION_READ_AFTER_BAD, [DonationDisciplineChecker()])
+    assert checks_of(findings) == {"donation-discipline"}
+    assert any("read after being donated" in f.message for f in findings)
+
+
+def test_donation_discipline_passes_clean_twin():
+    assert run_on_source(DONATION_CLEAN, [DonationDisciplineChecker()]) == []
+
+
+# ---------------------------------------------------------------------------
+# jaxlint: psum-axis (cross-module finish() pass)
+# ---------------------------------------------------------------------------
+
+PSUM_BAD = '''
+from jax import lax
+
+AXES = ("dp", "tp")
+
+def f(x):
+    return lax.psum(x, "sp")
+'''
+
+PSUM_CLEAN = '''
+from jax import lax
+
+AXES = ("dp", "tp")
+
+def f(x):
+    return lax.psum(x, "dp")
+
+def g(x, axis_name="tp"):
+    return lax.pmean(x, axis_name)
+'''
+
+PSUM_NO_DECLARATION = '''
+from jax import lax
+
+def f(x):
+    return lax.psum(x, "anything")
+'''
+
+
+def test_psum_axis_flags_undeclared_axis():
+    findings = run_on_source(PSUM_BAD, [PsumAxisChecker()])
+    assert checks_of(findings) == {"psum-axis"}
+    assert any("'sp'" in f.message for f in findings)
+
+
+def test_psum_axis_passes_declared_axes_including_defaults():
+    assert run_on_source(PSUM_CLEAN, [PsumAxisChecker()]) == []
+
+
+def test_psum_axis_silent_without_any_declaration():
+    # no mesh axes declared anywhere in the scanned set: no basis to judge
+    assert run_on_source(PSUM_NO_DECLARATION, [PsumAxisChecker()]) == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,262 @@
+"""JAXGUARD runtime-twin contract tests (ISSUE 12).
+
+The static jaxlint pass proves the SOURCE carries no retrace hazard or
+hot-loop host sync; these tests prove the PROCESS guard catches the same
+sins at runtime — and that it costs nothing when disarmed:
+
+- a guarded region whose jit retraces past its declared compile budget
+  raises CompileBudgetError at region exit;
+- a device_get past an armed region's per-entry transfer budget raises
+  HostTransferError BEFORE fetching, with the offending call site as the
+  innermost user frame of the traceback;
+- allow_transfer() is the audited runtime twin of the
+  `# lint: disable=host-transfer` pragma;
+- a donation the runtime silently ignores (un-aliasable output shape)
+  raises DonationError, while an honored donation passes;
+- the per-call audit stays under 10% overhead armed and the whole module
+  is inert with JAXGUARD unset (same bar as the invcheck overhead test);
+- the serving regression: at steady state the engine performs exactly ONE
+  host sync per decode burst (the batched post-burst drain) and holds the
+  declared burst compile budget.
+"""
+import time
+import traceback
+import warnings
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from odh_kubeflow_tpu.analysis import hotregions
+from odh_kubeflow_tpu.utils import jaxguard
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("JAXGUARD", "1")
+
+
+# ---------------------------------------------------------------------------
+# registry contract
+# ---------------------------------------------------------------------------
+
+
+def test_registry_rejects_unknown_region_names():
+    with pytest.raises(KeyError):
+        hotregions.get("serving.typo")
+    with pytest.raises(KeyError):
+        # a typo'd region fails at DECORATION time, not first dispatch
+        jaxguard.jit(lambda x: x, region="serving.typo")  # lint: disable=retrace-hazard
+
+
+def test_registry_declares_the_data_plane_regions():
+    burst = hotregions.get("serving.decode_burst")
+    assert burst.compile_budget == 2  # warmup + steady-state shapes
+    assert burst.transfer_budget == 0  # steady state syncs NOTHING in-region
+    assert hotregions.get("serving.prefill").transfer_budget == 1
+
+
+# ---------------------------------------------------------------------------
+# compile-count budget
+# ---------------------------------------------------------------------------
+
+
+def test_compile_counter_attributes_traces_always_even_unarmed():
+    def mul(x, n):
+        return x * n
+
+    before = jaxguard.compile_count("bench.train_step")
+    f = jaxguard.jit(mul, region="bench.train_step", static_argnums=(1,))
+    f(jnp.ones(4), 2)
+    f(jnp.ones(4), 2)  # cache hit: no trace
+    f(jnp.ones(4), 3)  # new static value: retrace
+    assert jaxguard.compile_count("bench.train_step") - before == 2
+
+
+def test_compile_budget_breach_raises_at_region_exit(armed):
+    def mul(x, n):
+        return x * n
+
+    f = jaxguard.jit(mul, region="bench.train_step", static_argnums=(1,))
+    guard = jaxguard.region("bench.train_step")  # declared budget: 1
+    with guard:
+        f(jnp.ones(4), 2)  # one trace: within budget
+    assert guard.compiles == 1
+    with pytest.raises(jaxguard.CompileBudgetError, match="compile budget 1"):
+        with guard:
+            f(jnp.ones(4), 3)  # static value churns per call:
+            f(jnp.ones(4), 4)  # the retrace leak the budget exists to catch
+
+
+# ---------------------------------------------------------------------------
+# transfer guard
+# ---------------------------------------------------------------------------
+
+
+def _offending_fetch(x):
+    return jax.device_get(x)
+
+
+_OFFENDING_LINE = _offending_fetch.__code__.co_firstlineno + 1
+
+
+def test_transfer_in_zero_budget_region_raises_at_offending_line(armed):
+    x = jnp.ones(3)
+    with pytest.raises(jaxguard.HostTransferError) as excinfo:
+        with jaxguard.region("serving.decode_burst"):  # transfer budget 0
+            _offending_fetch(x)
+    frames = traceback.extract_tb(excinfo.tb)
+    ours = [f for f in frames if f.filename == _offending_fetch.__code__.co_filename]
+    # innermost user frame is the device_get call site itself: the raise
+    # happens BEFORE the fetch, inside the shim
+    assert ours[-1].lineno == _OFFENDING_LINE
+
+
+def test_transfer_budget_allows_the_declared_fetch_then_raises(armed):
+    x = jnp.ones(3)
+    with pytest.raises(jaxguard.HostTransferError):
+        with jaxguard.region("serving.prefill"):  # transfer budget 1
+            jax.device_get(x)  # the budgeted first-token fetch: fine
+            jax.device_get(x)  # the second sync is the regression
+
+
+def test_transfer_budget_is_per_entry_not_cumulative(armed):
+    x = jnp.ones(3)
+    guard = jaxguard.region("serving.prefill")
+    for _ in range(3):
+        with guard:
+            jax.device_get(x)  # one per entry, every entry: within budget
+
+
+def test_allow_transfer_is_the_runtime_pragma(armed):
+    x = jnp.ones(3)
+    with jaxguard.region("serving.decode_burst"):
+        with jaxguard.allow_transfer():  # audited escape hatch
+            jax.device_get(x)
+    # outside the allow window the same call still raises
+    with pytest.raises(jaxguard.HostTransferError):
+        with jaxguard.region("serving.decode_burst"):
+            jax.device_get(x)
+
+
+def test_transfer_counter_visible_for_stats(armed):
+    x = jnp.ones(3)
+    before = jaxguard.transfer_count()
+    with jaxguard.region("serving.prefill"):
+        jax.device_get(x)
+    assert jaxguard.transfer_count() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+
+def test_ignored_donation_raises_donation_error(armed):
+    def shrink(x):
+        return x[:1] * 2.0  # output cannot alias the donated input's buffer
+
+    bad = jaxguard.jit(shrink, region="bench.train_step", donate_argnums=(0,))
+    with warnings.catch_warnings():
+        # jax itself warns "Some donated buffers were not usable" — the
+        # audit turns exactly that condition into a hard error
+        warnings.simplefilter("ignore")
+        with pytest.raises(jaxguard.DonationError, match="NOT.*aliased"):
+            bad(jnp.arange(8, dtype=jnp.float32))
+
+
+def test_honored_donation_passes_and_input_is_recycled(armed):
+    def bump(x):
+        return x + 1.0  # same shape/dtype: XLA aliases in place
+
+    good = jaxguard.jit(bump, region="bench.train_step", donate_argnums=(0,))
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = good(x)
+    assert x.is_deleted()  # the donation actually happened
+    assert jax.device_get(out)[0] == 1.0
+
+
+def test_donation_audit_inert_when_unarmed(monkeypatch):
+    monkeypatch.delenv("JAXGUARD", raising=False)
+
+    def shrink(x):
+        return x[:1] * 2.0
+
+    bad = jaxguard.jit(shrink, region="bench.train_step", donate_argnums=(0,))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bad(jnp.arange(8, dtype=jnp.float32))  # no audit, no raise
+
+
+# ---------------------------------------------------------------------------
+# cost: <10% armed, inert off (the invcheck overhead bar)
+# ---------------------------------------------------------------------------
+
+
+def test_region_is_noop_when_unarmed(monkeypatch):
+    monkeypatch.delenv("JAXGUARD", raising=False)
+    guard = jaxguard.region("serving.decode_burst")
+    with guard:
+        jax.device_get(jnp.ones(2))  # zero-budget region, but guard is off
+    assert guard.compiles == 0
+
+
+def test_armed_donation_audit_overhead_under_ten_percent(armed):
+    def bump(x):
+        return x + 1.0
+
+    plain = jax.jit(bump, donate_argnums=(0,))
+    guarded = jaxguard.jit(bump, region="bench.train_step", donate_argnums=(0,))
+    n = 200
+
+    def run(fn):
+        x = jnp.arange(64, dtype=jnp.float32)
+        fn(x).block_until_ready()  # compile outside the timed window
+        x = jnp.arange(64, dtype=jnp.float32)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = fn(x)
+        x.block_until_ready()
+        return (time.perf_counter() - t0) / n
+
+    base = min(run(plain) for _ in range(3))
+    armed_cost = min(run(guarded) for _ in range(3))
+    added = armed_cost - base
+    # same bar as the invcheck overhead test: 10% or an absolute floor that
+    # absorbs scheduler noise on a loaded CI box
+    assert added < max(0.10 * base, 0.0005), (
+        f"donation audit adds {added * 1e6:.1f}us/call over {base * 1e6:.1f}us"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the serving steady-state regression (satellite 6)
+# ---------------------------------------------------------------------------
+
+
+def test_engine_steady_state_one_host_sync_per_burst(armed):
+    """The engine bug this PR fixes: the steady-state loop used to drain
+    five device values with five separate host syncs per burst. Under an
+    armed guard the burst region (transfer budget 0) proves no in-region
+    sync survives, and the post-burst drain is ONE batched device_get."""
+    from odh_kubeflow_tpu.models import TransformerConfig, init_params
+    from odh_kubeflow_tpu.serving.engine import ServingEngine
+
+    cfg = TransformerConfig(
+        vocab=97, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+        max_seq=64, dtype=jnp.float32, use_flash=False, remat=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    eng = ServingEngine(params, cfg, max_slots=2, max_seq=64)
+    handles = [eng.submit([1, 2, 3], max_new=6) for _ in range(3)]
+    assert eng.run_until_idle(timeout=120)
+    assert all(h.result == "ok" for h in handles)
+    stats = eng.stats()
+    assert stats["host_transfers_last_burst"] == 1, (
+        "steady-state drain must be ONE batched device_get per burst"
+    )
+    burst = hotregions.get("serving.decode_burst")
+    assert stats["decode_burst_recompiles"] <= burst.compile_budget
