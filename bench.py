@@ -1379,6 +1379,13 @@ def bench_control_plane():
         return nb
 
     cluster = SimCluster().start()
+    # API priority & fairness in front of every request (ISSUE 13): the
+    # storm below runs through admission, and the artifact reports
+    # shed/queued/p99 wait per priority level
+    from odh_kubeflow_tpu.cluster.flowcontrol import FlowController
+
+    flowcontrol = FlowController()
+    cluster.store.flowcontrol = flowcontrol
     agents = {}
     cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
     # +1 spare v5e slice: the black-box canary drives one tiny notebook at a
@@ -1474,10 +1481,22 @@ def bench_control_plane():
         # keep the failure visible (the slice_repair section does the same):
         # nulls alone are indistinguishable from "not yet settled"
         out_slo["slo_error"] = slo_section["error"]
+    # the flowcontrol section (ISSUE 13): per-priority-level shed/queued/
+    # p99-wait across everything this bench just pushed through admission
+    flow_levels = {
+        level: {
+            "dispatched": stats["dispatched"],
+            "shed": stats["rejected"] + stats["timed_out"],
+            "queued": stats["queued"],
+            "p99_wait_s": stats["p99_wait_s"],
+        }
+        for level, stats in flowcontrol.summary().items()
+    }
     return {
         "slice_repair": slice_repair,
         "suspend_resume": suspend_resume,
         "batch": batch,
+        "flowcontrol": flow_levels,
         **out_slo,
         "cr_to_mesh_ready_p50_s": round(statistics.median(latencies.values()), 4),
         # where the time goes: per-phase p50 from the connected readiness

@@ -135,4 +135,27 @@ echo "=== job lane: JAXGUARD=1 iteration ==="
 JAXGUARD=1 python -m pytest tests/test_job.py -q -m "job and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, +1 jaxguard on serving/job, incl. slice chaos + pool churn + serving + job) ==="
+# overload lane (ISSUE 13): the apiserver_overload schedule (429 bursts +
+# latency injection + store throttles) under a TPUJob admission storm
+# against the flow-controlled, sharded control plane — asserts the storm is
+# shed at the batch priority level, exempt (lease) traffic is NEVER starved,
+# zero silently-stuck objects, and the sharding/fencing contract holds
+# (stand-down before the next write on lease loss, dead-elector healthz,
+# fenced retries rejected not duplicated) — rerun under the stress loop +
+# one RACECHECK=1 and one INVCHECK=1 iteration
+for i in $(seq 1 "$REPEAT"); do
+    echo "=== overload lane: iteration $i/$REPEAT ==="
+    python -m pytest tests/test_overload.py tests/test_sharding.py tests/test_flowcontrol.py \
+        -q -m "(overload or flowcontrol) and not slow" \
+        -p no:cacheprovider -p no:randomly "$@"
+done
+echo "=== overload lane: RACECHECK=1 iteration ==="
+RACECHECK=1 python -m pytest tests/test_overload.py tests/test_sharding.py tests/test_flowcontrol.py \
+    -q -m "(overload or flowcontrol) and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+echo "=== overload lane: INVCHECK=1 iteration ==="
+INVCHECK=1 python -m pytest tests/test_overload.py tests/test_sharding.py tests/test_flowcontrol.py \
+    -q -m "(overload or flowcontrol) and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, +1 jaxguard on serving/job, incl. slice chaos + pool churn + serving + job + overload) ==="

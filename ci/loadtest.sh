@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# SLO-gated loadtest lane (ISSUE 13): the 200-object mixed-class tier
+# (loadtest/tiers.py) against the sharded, flow-controlled control plane.
+#
+# The tier brings up CPU+TPU notebooks, InferenceEndpoints and back-to-back
+# TPUJob streams through one store under two shard managers + a warm
+# standby, slams a TPUJob admission storm into the batch priority level
+# mid-run, then kills the active shard-0 leader. Its exit status IS the SLO
+# verdict: the surviving manager's own SLO engine must show every gated SLO
+# (readiness-latency-p99, canary-readiness, job-completion,
+# serving-availability) at-or-above objective with zero gated firing
+# alerts, the storm must have been shed at batch and ONLY batch, takeover
+# must land within lease bounds, and zero writes may hit the fence.
+#
+#   ./ci/loadtest.sh                 # the 200-object CI tier
+#   LOADTEST_TIER=500 ./ci/loadtest.sh   # the slow 500-object tier (manual /
+#                                        # nightly: not part of tier-1 time)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIER="${LOADTEST_TIER:-200}"
+export PYTHONHASHSEED="${PYTHONHASHSEED:-0}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "=== loadtest lane: ${TIER}-object tier ==="
+python loadtest/tiers.py --objects "$TIER" "$@"
+echo "=== loadtest lane: ${TIER}-object tier passed its SLO verdict ==="

@@ -1,0 +1,585 @@
+"""SLO-gated loadtest tiers (ISSUE 13): 200- and 500-object mixed-class
+populations against the SHARDED, flow-controlled control plane.
+
+One tier run drives, through a single store:
+
+- a mixed population sized by --objects (CPU notebooks + TPU notebooks +
+  InferenceEndpoints + back-to-back TPUJob streams, deterministic split),
+- TWO shard managers (crc32 keyspace partition, per-shard leases) plus a
+  warm standby for shard 0,
+- a mid-run TPUJob admission storm slammed into the batch priority level
+  while its seats are held — the storm must be shed THERE (429s at the
+  batch level, zero sheds at exempt/workload-high),
+- a kill of the active shard-0 leader mid-tier — the standby must take
+  over within lease bounds with zero fenced-off duplicate writes, and the
+  SLO verdict is read from the SURVIVING manager's own judgement layer.
+
+Pass/fail is the SLO engine's statuses (readiness-latency-p99,
+canary-readiness, job-completion, serving-availability) + firing alerts +
+the control-plane gates above — never ad-hoc thresholds. The 200-object
+tier is the CI lane (ci/loadtest.sh); the 500-object tier is the slow one:
+
+  python loadtest/tiers.py --objects 200
+  python loadtest/tiers.py --objects 500
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the SLOs this tier's traffic actually drives; their compliance + alerts
+# are the verdict (ISSUE 13 acceptance list)
+GATED_SLOS = ("readiness-latency-p99", "canary-readiness", "job-completion",
+              "serving-availability")
+
+STEP_PER_CKPT = 30
+JOB_STREAMS = 6
+STORM_THREADS = 12
+STORM_PER_THREAD = 2
+
+
+def composition(objects: int) -> dict:
+    """Deterministic mixed-class split of the object budget."""
+    endpoints = max(1, objects // 40)
+    tpu_notebooks = max(2, objects // 20)
+    jobs = max(4, objects // 4)
+    return {
+        "cpu_notebooks": objects - endpoints - tpu_notebooks - jobs,
+        "tpu_notebooks": tpu_notebooks,
+        "endpoints": endpoints,
+        "jobs": jobs,
+    }
+
+
+def run(args) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.api.core import Container
+    from odh_kubeflow_tpu.api.inference import InferenceEndpoint, ServingSpec
+    from odh_kubeflow_tpu.api.job import TPUJob
+    from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+    from odh_kubeflow_tpu.apimachinery import (
+        NotFoundError,
+        TooManyRequestsError,
+    )
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.cluster.flowcontrol import (
+        FlowController,
+        PriorityLevel,
+        default_flow_schemas,
+    )
+    from odh_kubeflow_tpu.controllers import Config, constants as C
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.models import TransformerConfig, init_params
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.runtime import metrics as rm
+    from odh_kubeflow_tpu.runtime.manager import ShardSpec
+    from odh_kubeflow_tpu.serving.engine import QueueFull, ServingEngine
+
+    ns = args.namespace
+    mix = composition(args.objects)
+    duration = args.duration or (20.0 + args.objects * 0.03)
+    setup_budget = 120 + args.objects * 0.3
+    # lease scaled with the population: the leader's renew thread is pure
+    # python competing with every controller, probe, and engine thread for
+    # the GIL, and at 500 objects it can be starved past a 2 s lease — which
+    # the live standby elector correctly reads as leader death and steals.
+    # The kill gate's bound scales with the same numbers, so the failover
+    # guarantee stays proportional, not absolute.
+    lease, renew = (2.0, 0.4) if args.objects <= 200 else (8.0, 1.0)
+
+    cluster = SimCluster().start()
+    # the batch budget is pinned narrow so the injected storm contends
+    # deterministically; everything else is the default APF-analog layout
+    fc = FlowController(
+        schemas=default_flow_schemas(),
+        levels=[
+            PriorityLevel("exempt", exempt=True),
+            PriorityLevel("system", seats=16, queue_length=64, queue_timeout_s=10.0),
+            PriorityLevel("workload-high", seats=12, queue_length=64,
+                          queue_timeout_s=10.0),
+            PriorityLevel("batch", seats=4, queue_length=4, queue_timeout_s=0.3),
+            PriorityLevel("default", seats=8, queue_length=32, queue_timeout_s=5.0),
+        ],
+    )
+    cluster.store.flowcontrol = fc
+    cluster.add_tpu_pool(
+        "tiers", "v5e", "2x2",
+        slices=mix["tpu_notebooks"] + mix["endpoints"] + JOB_STREAMS,
+    )
+    cluster.add_cpu_pool("cpu", nodes=max(3, args.objects // 40), cpu="64")
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+
+    job_steps = {}
+
+    def http_get(url, timeout=10.0):
+        if "/tpu/checkpoint" in url and "-learner-" in url:
+            name = url.split("//", 1)[1].split("-learner-", 1)[0]
+            job_steps[name] = job_steps.get(name, 0) + STEP_PER_CKPT
+            return 200, json.dumps(
+                {"saved": True, "step": job_steps[name]}
+            ).encode()
+        return cluster.http_get(url, timeout=timeout)
+
+    config = Config(
+        enable_culling=False,
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        serving_loading_window_s=10.0,
+        serving_drain_timeout_s=0.5,
+        slo_enabled=True,
+        slo_window_scale=max(1e-4, duration / 600.0),
+        # CPU canary: the black-box prober keeps driving the full create->
+        # ready->delete path through the storm AND the failover window;
+        # canary_timeout_s covers the lease-bound takeover gap so a probe
+        # in flight during failover lands late, not failed
+        canary_period_s=0.5,
+        canary_timeout_s=30.0,
+        job_checkpoint_window_s=2.0,
+        job_requeue_backoff_s=0.2,
+    )
+    # only the shard-0 primary registers the (store-global) admission
+    # webhook; shard 1 carries no judgement layer of its own — the SLO
+    # engine reads the process-global registry, one evaluator is the truth
+    mgr0 = build_manager(cluster.store, config, leader_election=True,
+                         http_get=http_get, shard=ShardSpec(0, 2),
+                         lease_duration=lease, renew_period=renew)
+    mgr1 = build_manager(cluster.store,
+                         dataclasses.replace(config, slo_enabled=False),
+                         leader_election=True, http_get=http_get,
+                         shard=ShardSpec(1, 2), lease_duration=lease,
+                         renew_period=renew, register_webhook=False)
+    # the warm standby for shard 0 carries its OWN judgement layer: after
+    # the kill, the verdict must come from the surviving manager
+    standby = build_manager(cluster.store, config, leader_election=True,
+                            http_get=http_get, shard=ShardSpec(0, 2),
+                            lease_duration=lease, renew_period=renew,
+                            register_webhook=False)
+    fenced0 = rm.fenced_writes_total.value()
+    mgr0.start(wait_for_leadership_timeout=10)
+    mgr1.start(wait_for_leadership_timeout=10)
+    standby_up = threading.Event()
+
+    def run_standby():
+        # the wait must outlast the whole tier up to the kill: bring-up,
+        # steady state, and the storm all happen before mgr0 dies. A timeout
+        # here does NOT stop the elector, so an early give-up leaves a live
+        # elector that steals the lease at the first starved renew — exactly
+        # the spurious-failover the tier must not inject itself.
+        standby.start(
+            wait_for_leadership_timeout=int(setup_budget + duration + 600)
+        )
+        standby_up.set()
+
+    standby_thread = threading.Thread(target=run_standby, daemon=True)
+    standby_thread.start()
+
+    driver = cluster.client
+    result = {"objects": args.objects, "composition": mix,
+              "duration_s": round(duration, 1)}
+    failures = []
+
+    def create_persistent(obj, attempts=200):
+        for _ in range(attempts):
+            try:
+                return driver.create(obj)
+            except TooManyRequestsError:
+                time.sleep(0.05)
+        raise SystemExit(f"create never admitted: {obj.metadata.name}")
+
+    def wait_for(fn, timeout, msg):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if fn():
+                    return
+            except TooManyRequestsError:
+                pass
+            time.sleep(0.05)
+        raise SystemExit(f"tier setup timeout: {msg}")
+
+    engine = None
+    try:
+        # ------------------------------------------------------------------
+        # population bring-up (feeds readiness-latency-p99)
+        # ------------------------------------------------------------------
+        for i in range(mix["cpu_notebooks"]):
+            nb = Notebook()
+            nb.metadata.name = f"cpu-{i}"
+            nb.metadata.namespace = ns
+            nb.spec.template.spec.containers = [
+                Container(name=f"cpu-{i}", image="jupyter:1")
+            ]
+            create_persistent(nb)
+        for i in range(mix["tpu_notebooks"]):
+            nb = Notebook()
+            nb.metadata.name = f"tpu-{i}"
+            nb.metadata.namespace = ns
+            nb.spec.template.spec.containers = [
+                Container(name=f"tpu-{i}", image="jax:1")
+            ]
+            nb.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+            create_persistent(nb)
+        for i in range(mix["endpoints"]):
+            ep = InferenceEndpoint()
+            ep.metadata.name = f"serve-{i}"
+            ep.metadata.namespace = ns
+            ep.spec.template.spec.containers = [
+                Container(name=f"serve-{i}", image="s:1")
+            ]
+            ep.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+            ep.spec.serving = ServingSpec(max_batch_slots=8, max_queue_depth=64,
+                                          max_seq=256, max_new_tokens=64)
+            create_persistent(ep)
+
+        wait_for(
+            lambda: all(
+                driver.get(Notebook, ns, f"cpu-{i}").status.ready_replicas >= 1
+                for i in range(mix["cpu_notebooks"])
+            ),
+            setup_budget, "CPU notebooks Ready",
+        )
+        wait_for(
+            lambda: all(
+                (lambda got: got.status.tpu is not None and got.status.tpu.mesh_ready)(
+                    driver.get(Notebook, ns, f"tpu-{i}")
+                )
+                for i in range(mix["tpu_notebooks"])
+            ),
+            setup_budget, "TPU notebooks mesh-ready",
+        )
+        wait_for(
+            lambda: all(
+                driver.get(InferenceEndpoint, ns, f"serve-{i}")
+                .metadata.annotations.get(C.INFERENCE_STATE_ANNOTATION) == "serving"
+                for i in range(mix["endpoints"])
+            ),
+            setup_budget, "endpoints Serving",
+        )
+        traceparent = driver.get(
+            InferenceEndpoint, ns, "serve-0"
+        ).metadata.annotations.get(C.TRACEPARENT_ANNOTATION)
+
+        # ------------------------------------------------------------------
+        # batch streams (feeds job-completion) + serving stream
+        # ------------------------------------------------------------------
+        batch = {"submitted": 0, "succeeded": 0, "failed": 0}
+        batch_lock = threading.Lock()
+        stop_jobs = threading.Event()
+
+        def drive_jobs(stream: int):
+            i = 0
+            while not stop_jobs.is_set():
+                with batch_lock:
+                    if batch["submitted"] >= mix["jobs"]:
+                        return
+                    batch["submitted"] += 1
+                name = f"batch-{stream}-{i}"
+                job = TPUJob()
+                job.metadata.name = name
+                job.metadata.namespace = ns
+                job.spec.template.spec.containers = [
+                    Container(name=name, image="jax:1")
+                ]
+                job.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+                job.spec.steps = 30
+                job.spec.checkpoint_period_s = 0.1
+                create_persistent(job)
+                deadline = time.monotonic() + 60
+                state = ""
+                while time.monotonic() < deadline and not stop_jobs.is_set():
+                    try:
+                        state = driver.get(
+                            TPUJob, ns, name
+                        ).metadata.annotations.get(C.JOB_STATE_ANNOTATION, "")
+                    except TooManyRequestsError:
+                        pass  # the storm sheds driver polls too; keep going
+                    if state in ("succeeded", "failed"):
+                        break
+                    time.sleep(0.05)
+                with batch_lock:
+                    if state == "succeeded":
+                        batch["succeeded"] += 1
+                    elif state == "failed":
+                        batch["failed"] += 1
+                try:
+                    driver.delete(TPUJob, ns, name)
+                except (NotFoundError, TooManyRequestsError):
+                    pass
+                i += 1
+
+        jobbers = [
+            threading.Thread(target=drive_jobs, args=(s,), daemon=True)
+            for s in range(JOB_STREAMS)
+        ]
+        for jobber in jobbers:
+            jobber.start()
+
+        cfg = TransformerConfig(
+            vocab=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq=256, dtype=jnp.float32, use_flash=False,
+            remat=False,
+        )
+        engine = ServingEngine(
+            init_params(jax.random.PRNGKey(0), cfg), cfg,
+            max_slots=8, max_seq=256, max_queue_depth=64, decode_burst=8,
+        ).start()
+        stream = {"submitted": 0, "rejected": 0, "handles": []}
+        stop_stream = threading.Event()
+
+        def drive_stream():
+            rng = random.Random(0)
+            period = 1.0 / max(0.1, args.qps)
+            while not stop_stream.is_set():
+                prompt = [rng.randrange(cfg.vocab) for _ in range(16)]
+                try:
+                    stream["handles"].append(engine.submit(
+                        prompt, max_new=rng.choice((8, 16, 32)),
+                        traceparent=traceparent,
+                    ))
+                    stream["submitted"] += 1
+                except QueueFull:
+                    stream["rejected"] += 1
+                stop_stream.wait(period)
+
+        streamer = threading.Thread(target=drive_stream, daemon=True)
+        streamer.start()
+
+        t_run = time.monotonic()
+        deadline = t_run + duration
+        time.sleep(min(duration * 0.2, 5.0))
+
+        # ------------------------------------------------------------------
+        # the injected TPUJob admission storm: every batch seat is held while
+        # anonymous creates slam the level — queue-full sheds are guaranteed,
+        # and they must land at batch and ONLY at batch
+        # ------------------------------------------------------------------
+        storm = {"attempted": 0, "admitted": [], "shed_creates": 0}
+        seats = fc.summary()["batch"]["seats"]
+        hogs = [fc.admit("tpu-job") for _ in range(seats)]
+        exempt_before = fc.summary()["exempt"]["dispatched"]
+
+        def storm_driver(t: int):
+            for i in range(STORM_PER_THREAD):
+                name = f"storm-{t}-{i}"
+                job = TPUJob()
+                job.metadata.name = name
+                job.metadata.namespace = ns
+                job.spec.template.spec.containers = [
+                    Container(name=name, image="jax:1")
+                ]
+                job.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+                job.spec.steps = 60
+                job.spec.checkpoint_period_s = 0.2
+                storm["attempted"] += 1
+                try:
+                    driver.create(job)
+                    storm["admitted"].append(name)
+                except TooManyRequestsError:
+                    storm["shed_creates"] += 1
+
+        stormers = [
+            threading.Thread(target=storm_driver, args=(t,), daemon=True)
+            for t in range(STORM_THREADS)
+        ]
+        for s in stormers:
+            s.start()
+        time.sleep(0.8)  # the storm beats on a saturated level
+        for h in hogs:
+            h.release()
+        for s in stormers:
+            s.join(20)
+        # storm jobs that made it through admission are withdrawn: the storm
+        # is load, not workload — it must not consume the job budget
+        for name in storm["admitted"]:
+            try:
+                driver.delete(TPUJob, ns, name)
+            except (NotFoundError, TooManyRequestsError):
+                pass
+
+        s = fc.summary()
+        storm_shed = s["batch"]["rejected"] + s["batch"]["timed_out"]
+        if storm_shed <= 0:
+            failures.append("storm was never shed at the batch level")
+        if s["workload-high"]["rejected"] or s["workload-high"]["timed_out"]:
+            failures.append("protected workload-high level shed during the storm")
+        if s["exempt"]["rejected"] or s["exempt"]["timed_out"]:
+            failures.append("exempt (lease) traffic shed during the storm")
+        if s["exempt"]["dispatched"] <= exempt_before:
+            failures.append("no exempt traffic flowed through the storm")
+
+        # ------------------------------------------------------------------
+        # kill the active shard-0 leader mid-tier
+        # ------------------------------------------------------------------
+        time.sleep(0.5)
+        t_kill = time.monotonic()
+        mgr0.stop()
+        # the graceful stop drains services for a while, and the elector
+        # keeps renewing until it is stopped partway through — so the lease
+        # only starts aging out at (at latest) stop-return. The lease-bound
+        # gate measures from there to the standby's is_leader flip (the
+        # failover event itself); controller/service bring-up on the new
+        # leader is real work but not lease arithmetic, reported separately.
+        stop_s = time.monotonic() - t_kill
+        lease_bound = lease + 2 * renew + 2.0
+        acquire_deadline = time.monotonic() + lease + 4 * renew + 10.0
+        while (not standby.elector.is_leader.is_set()
+               and time.monotonic() < acquire_deadline):
+            time.sleep(0.01)
+        if not standby.elector.is_leader.is_set():
+            failures.append("standby never took over shard 0")
+            takeover_s = None
+        else:
+            takeover_s = time.monotonic() - t_kill
+            # past the bound means the storm starved failover: the old lease
+            # ages out (>= the lease duration past the last renew), then one standby
+            # acquire tick lands
+            if takeover_s - stop_s > lease_bound:
+                failures.append(
+                    f"takeover took {takeover_s - stop_s:.2f}s past leader "
+                    f"death (bound {lease_bound:.2f}s)"
+                )
+        if not standby_up.wait(90.0):
+            failures.append("standby controllers never came up after takeover")
+        standby_ready_s = time.monotonic() - t_kill
+
+        # ------------------------------------------------------------------
+        # ride out the rest of the tier on the surviving managers
+        # ------------------------------------------------------------------
+        # steady state until the deadline, then a completion tail so the job
+        # quota actually runs (the tier's object count is the point); a hard
+        # cap keeps a wedged stream from hanging the lane
+        hard_cap = deadline + max(90.0, duration)
+        while time.monotonic() < hard_cap:
+            with batch_lock:
+                done = batch["succeeded"] + batch["failed"]
+                quota_done = batch["submitted"] >= mix["jobs"] and done >= mix["jobs"]
+            if quota_done and time.monotonic() >= deadline:
+                break
+            time.sleep(0.1)
+        stop_jobs.set()
+        stop_stream.set()
+        streamer.join(timeout=5)
+        for jobber in jobbers:
+            jobber.join(timeout=70)
+        engine.stop(drain_timeout_s=10.0)
+
+        fenced_delta = rm.fenced_writes_total.value() - fenced0
+        if fenced_delta:
+            failures.append(
+                f"{fenced_delta} fenced-off write(s): the dying leader kept "
+                "writing past its lease"
+            )
+        if not standby.healthz():
+            failures.append("surviving shard-0 manager unhealthy after takeover")
+        if not mgr1.healthz():
+            failures.append("shard-1 manager unhealthy at tier end")
+
+        # ------------------------------------------------------------------
+        # the verdict comes from the SURVIVOR's judgement layer
+        # ------------------------------------------------------------------
+        statuses = standby.slo_engine.evaluate()
+        alerts = standby.alert_manager.status()
+        all_firing = sorted(
+            a.get("rule", a.get("name", "?")) for a in alerts.get("firing", [])
+        )
+        firing = [
+            name for name in all_firing
+            if any(name.startswith(slo) for slo in GATED_SLOS)
+        ]
+        gates = {}
+        ok = True
+        for name in GATED_SLOS:
+            st = statuses.get(name, {})
+            compliance = st.get("compliance")
+            objective = st.get("objective")
+            passed = (
+                compliance is not None and objective is not None
+                and compliance >= objective
+            )
+            gates[name] = {
+                "compliance": compliance,
+                "objective": objective,
+                "events": st.get("events"),
+                "passed": passed,
+            }
+            ok = ok and passed
+        ok = ok and not firing and not failures
+
+        summary = fc.summary()
+        result.update({
+            "jobs_submitted": batch["submitted"],
+            "jobs_succeeded": batch["succeeded"],
+            "jobs_failed": batch["failed"],
+            "requests_submitted": stream["submitted"],
+            "requests_rejected": stream["rejected"],
+            "requests_ok": sum(1 for h in stream["handles"] if h.result == "ok"),
+            "storm": {
+                "attempted": storm["attempted"],
+                "admitted_then_withdrawn": len(storm["admitted"]),
+                "driver_visible_sheds": storm["shed_creates"],
+                "batch_level_sheds": storm_shed,
+            },
+            "takeover_s": round(takeover_s, 3) if takeover_s else None,
+            "leader_stop_s": round(stop_s, 3),
+            "takeover_past_leader_death_s": (
+                round(takeover_s - stop_s, 3) if takeover_s else None
+            ),
+            "takeover_bound_s": round(lease_bound, 2),
+            "standby_controllers_up_s": round(standby_ready_s, 3),
+            "fenced_writes": fenced_delta,
+            # the control-plane section: shed/queued/p99 wait per level
+            "flowcontrol": {
+                level: {
+                    "dispatched": stats["dispatched"],
+                    "shed": stats["rejected"] + stats["timed_out"],
+                    "queued": stats["queued"],
+                    "p99_wait_s": stats["p99_wait_s"],
+                }
+                for level, stats in summary.items()
+            },
+            "slo_gates": gates,
+            "alerts_firing_gated": list(firing),
+            "alerts_firing_all": list(all_firing),
+            "control_plane_failures": list(failures),
+            "passed": bool(ok),
+        })
+    finally:
+        stop = getattr(standby, "stop", None)
+        if stop:
+            standby.stop()
+        mgr1.stop()
+        try:
+            mgr0.stop()  # idempotent; killed mid-tier on the happy path
+        except Exception:
+            pass
+        cluster.stop()
+    print(json.dumps(result, indent=2))
+    if not result.get("passed"):
+        raise SystemExit(1)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--objects", type=int, default=200, choices=(200, 500),
+                    help="tier size: 200 (CI lane) or 500 (slow tier)")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="steady-state seconds after bring-up "
+                         "(0 = scale with --objects)")
+    ap.add_argument("--qps", type=float, default=12.0)
+    ap.add_argument("--namespace", default="tiers")
+    run(ap.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import ssl
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, unquote, urlparse
@@ -124,7 +125,12 @@ class ApiServer:
         admission: Optional[AdmissionCallout] = None,
         heartbeat_polls: int = 30,
         audit_path: Optional[str] = None,
+        flowcontrol: Optional[Any] = None,
     ):
+        # API priority & fairness (cluster/flowcontrol.py FlowController):
+        # when set, every request takes a seat at its priority level before
+        # verb dispatch, classified by the client-stamped X-Flow-Schema header
+        self.flowcontrol = flowcontrol
         # idle 0.5s polls before a watch heartbeat/BOOKMARK (30 -> ~15s,
         # roughly kube-apiserver's bookmark cadence; tests dial it down)
         self.heartbeat_polls = heartbeat_polls
@@ -235,32 +241,56 @@ class ApiServer:
                 raise UnauthorizedError("missing or invalid bearer token")
             faults = getattr(self.store, "faults", None)
             if faults is not None:
-                # API priority & fairness rejection point: a matching rule
-                # answers 429 + Retry-After before any dispatch work
+                # injected overload rejection point: a matching rule answers
+                # 429 + Retry-After before any dispatch work; a "delay"
+                # action rule injects request latency (apiserver_overload)
                 faults.check("apiserver.request", method=method, path=h.path)
+                delay = faults.decide("apiserver.request", method=method, path=h.path)
+                if delay is not None and delay.action == "delay" and delay.param > 0:
+                    time.sleep(delay.param)
             parsed = urlparse(h.path)
             query = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
             route = self._parse_path(parsed.path)
             if route is None:
                 raise NotFoundError(f"the server could not find the requested resource {parsed.path!r}")
-            if method == "GET":
-                if route.name:
-                    code, body = self._get(h, route)
-                elif query.get("watch") in ("true", "1"):
-                    self._watch(h, route, query, method)
-                    return
+            # API priority & fairness: take a seat at the level matched by
+            # the caller's flow identity before any verb work; a full queue
+            # sheds 429 + Retry-After through the ApiError path below
+            ticket = None
+            if self.flowcontrol is not None:
+                ticket = self.flowcontrol.admit(
+                    h.headers.get("X-Flow-Schema", ""),
+                    verb=method.lower(),
+                    kind=route.kind,
+                )
+            try:
+                if method == "GET":
+                    if route.name:
+                        code, body = self._get(h, route)
+                    elif query.get("watch") in ("true", "1"):
+                        # a watch holds its connection for the stream's whole
+                        # lifetime — release the seat before streaming so
+                        # long-lived watches never pin the concurrency budget
+                        if ticket is not None:
+                            ticket.release()
+                            ticket = None
+                        self._watch(h, route, query, method)
+                        return
+                    else:
+                        code, body = self._list(h, route, query)
+                elif method == "POST" and not route.name:
+                    code, body = self._create(h, route)
+                elif method == "PUT" and route.name:
+                    code, body = self._update(h, route)
+                elif method == "PATCH" and route.name:
+                    code, body = self._patch(h, route)
+                elif method == "DELETE" and route.name:
+                    code, body = self._delete(h, route)
                 else:
-                    code, body = self._list(h, route, query)
-            elif method == "POST" and not route.name:
-                code, body = self._create(h, route)
-            elif method == "PUT" and route.name:
-                code, body = self._update(h, route)
-            elif method == "PATCH" and route.name:
-                code, body = self._patch(h, route)
-            elif method == "DELETE" and route.name:
-                code, body = self._delete(h, route)
-            else:
-                raise InvalidError(f"unsupported {method} on {parsed.path!r}")
+                    raise InvalidError(f"unsupported {method} on {parsed.path!r}")
+            finally:
+                if ticket is not None:
+                    ticket.release()
             # serialize INSIDE the try: an unserializable value (bad
             # admission-hook output) must take the 500 path below, not
             # escape after an "ok" audit record

@@ -37,9 +37,22 @@ class Client:
     # closes the window between lease loss and controller shutdown)
     write_fence: Optional[Callable[[], bool]] = None
 
+    # flow identity (cluster/flowcontrol.py): an explicit per-client override
+    # of the thread-local flow (the elector's client sets "leader-election"
+    # so lease traffic always lands on the exempt priority level). Empty =
+    # inherit whatever flow_context() the calling thread carries.
+    flow: str = ""
+
     def __init__(self, store: Store, scheme: Scheme = default_scheme):
         self.store = store
         self.scheme = scheme
+
+    def _flow(self) -> str:
+        if self.flow:
+            return self.flow
+        from .flowcontrol import current_flow
+
+        return current_flow()
 
     def _check_fence(self) -> None:
         fence = self.write_fence
@@ -49,8 +62,26 @@ class Client:
             fenced_writes_total.inc()
             raise ForbiddenError("write fenced: leader lease not held")
 
-    def _call(self, fn: Callable[[], T], write: bool = False) -> T:
+    def _call(self, fn: Callable[[], T], write: bool = False, kind: str = "") -> T:
         """Run a store op, honoring 429 Retry-After with bounded retries."""
+        # API priority & fairness, sim mode: a Store carrying a FlowController
+        # (cluster/flowcontrol.py) admits every typed-client op at the
+        # caller's priority level before it reaches the store — the
+        # in-process analog of the ApiServer's admission point. A shed raises
+        # TooManyRequestsError, which rides the bounded retry loop below
+        # exactly like a server-side 429.
+        flowcontrol = getattr(self.store, "flowcontrol", None)
+        if flowcontrol is not None and not getattr(
+            self.store, "handles_throttle_retries", False
+        ):
+            inner = fn
+
+            def fn() -> T:  # type: ignore[misc]
+                with flowcontrol.admit(
+                    self._flow(), verb="write" if write else "read", kind=kind
+                ):
+                    return inner()
+
         if getattr(self.store, "handles_throttle_retries", False):
             # the transport already retries 429s (RemoteStore._request);
             # stacking this loop on top would multiply the attempts and the
@@ -97,13 +128,20 @@ class Client:
     def create(self, obj: T) -> T:
         self._check_fence()
         payload = self._prepare(obj)
-        out = self._call(lambda: self.store.create_raw(payload), write=True)
+        out = self._call(
+            lambda: self.store.create_raw(payload),
+            write=True,
+            kind=payload.get("kind", ""),
+        )
         return self._decode(type(obj), out)
 
     def get(self, cls: Type[T], namespace: str, name: str) -> T:
         av, kind = self._av_kind(cls)
         return self._decode(
-            cls, self._call(lambda: self.store.get_raw(av, kind, namespace, name))
+            cls,
+            self._call(
+                lambda: self.store.get_raw(av, kind, namespace, name), kind=kind
+            ),
         )
 
     def list(
@@ -118,14 +156,19 @@ class Client:
             for d in self._call(
                 lambda: self.store.list_raw(
                     av, kind, namespace=namespace, label_selector=labels
-                )
+                ),
+                kind=kind,
             )
         ]
 
     def update(self, obj: T) -> T:
         self._check_fence()
         payload = self._prepare(obj)
-        out = self._call(lambda: self.store.update_raw(payload), write=True)
+        out = self._call(
+            lambda: self.store.update_raw(payload),
+            write=True,
+            kind=payload.get("kind", ""),
+        )
         return self._decode(type(obj), out)
 
     def update_status(self, obj: T) -> T:
@@ -134,6 +177,7 @@ class Client:
         out = self._call(
             lambda: self.store.update_raw(payload, subresource="status"),
             write=True,
+            kind=payload.get("kind", ""),
         )
         return self._decode(type(obj), out)
 
@@ -145,6 +189,7 @@ class Client:
             self._call(
                 lambda: self.store.patch_raw(av, kind, namespace, name, patch),
                 write=True,
+                kind=kind,
             ),
         )
 
@@ -162,13 +207,18 @@ class Client:
                     av, kind, namespace, name, {"status": patch}, subresource="status"
                 ),
                 write=True,
+                kind=kind,
             ),
         )
 
     def delete(self, cls: Type[KubeObject], namespace: str, name: str) -> None:
         self._check_fence()
         av, kind = self._av_kind(cls)
-        self._call(lambda: self.store.delete_raw(av, kind, namespace, name), write=True)
+        self._call(
+            lambda: self.store.delete_raw(av, kind, namespace, name),
+            write=True,
+            kind=kind,
+        )
 
 
 def retry_on_conflict(

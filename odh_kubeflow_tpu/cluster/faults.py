@@ -66,11 +66,12 @@ class FaultRule:
 
     site: str
     error: Optional[Callable[[], Exception]] = None  # raise-on-match
-    action: str = ""  # non-raising verdict ("crash", "partition")
+    action: str = ""  # non-raising verdict ("crash", "partition", "delay")
     kind: Optional[str] = None  # match ctx["kind"]
     name: Optional[str] = None  # substring match on ctx name/host/url
     times: Optional[int] = None  # budget; None = unlimited
     match: Optional[Callable[[Dict[str, Any]], bool]] = None  # extra predicate
+    param: float = 0.0  # action parameter (e.g. "delay" sleep seconds)
     fired: int = 0
 
     def _matches(self, site: str, ctx: Dict[str, Any]) -> bool:
@@ -293,6 +294,45 @@ class FaultInjector:
             site="probe.http", name=host, times=times,
             error=lambda: ConnectionError("injected network partition"),
         ))
+
+
+def apiserver_overload(injector: FaultInjector, seed: int,
+                       scale: float = 1.0) -> List[FaultRule]:
+    """A deterministic apiserver-overload schedule (ISSUE 13): the symptoms
+    an admission storm produces at the API boundary — bursts of 429 on create
+    traffic plus request-latency injection — with every budget drawn from
+    random.Random(seed). Pair it with a driver-side TPUJob create storm (the
+    overload lane in tests/test_overload.py, loadtest/tiers.py) so recovery
+    has real work: clients must retry through the bursts, nothing may wedge,
+    and exempt-level (lease) traffic must never be starved.
+
+    `scale` multiplies the drawn budgets so soak lanes can lengthen the bad
+    day without changing its shape."""
+    rng = random.Random(seed)
+
+    def n(lo: int, hi: int) -> int:
+        return max(1, int(rng.randint(lo, hi) * scale))
+
+    rules = [
+        # 429 bursts on create traffic at the HTTP boundary (wire mode)
+        injector.add(FaultRule(
+            site="apiserver.request", times=n(5, 15),
+            match=lambda ctx: ctx.get("method") == "POST",
+            error=lambda: TooManyRequestsError(
+                "injected apiserver overload", retry_after=0.05),
+        )),
+        # request-latency injection: every verb slows down under load
+        injector.add(FaultRule(
+            site="apiserver.request", action="delay",
+            param=0.005 * rng.randint(1, 6), times=n(10, 30),
+        )),
+        # the same 429 bursts at the store boundary (sim mode, where typed
+        # clients skip the HTTP layer); creates excluded per seeded_bad_day's
+        # rationale — the driver's storm itself must enter the system
+        *injector.throttle(times=n(4, 10), retry_after=0.02 * rng.randint(1, 3),
+                           match=lambda ctx: ctx.get("verb") != "create"),
+    ]
+    return rules
 
 
 def seeded_bad_day(injector: FaultInjector, seed: int,

@@ -620,6 +620,14 @@ class RemoteStore:
         headers = {"Accept": "application/json"}
         if content_type:
             headers["Content-Type"] = content_type
+        # flow identity for API priority & fairness: the server's
+        # FlowController classifies this request by the controller identity
+        # the calling thread carries (cluster/flowcontrol.py flow_context)
+        from .flowcontrol import current_flow
+
+        flow = current_flow()
+        if flow:
+            headers["X-Flow-Schema"] = flow
         # W3C trace propagation: API calls made under an active span carry
         # its context, so server-side traces join the caller's
         from ..utils.tracing import current_traceparent

@@ -26,11 +26,13 @@ def build_remote_stack(
     token: str = "wire-token",
     qps: float = 0.0,
     burst: int = 0,
+    flowcontrol: Any = None,
 ) -> Tuple[Any, Any, Any]:
     """Returns (api_server, remote_store, webhook_server). qps=0 (default)
     leaves the client unthrottled — timing-sensitive e2e suites must not
     absorb rate-limiter sleeps they never asked for; the loadtest opts in
-    explicitly."""
+    explicitly. `flowcontrol` (a cluster.flowcontrol.FlowController) puts
+    API priority & fairness in front of the apiserver's dispatch."""
     from ..api.admission import (
         MutatingWebhook,
         MutatingWebhookConfiguration,
@@ -66,6 +68,7 @@ def build_remote_stack(
         keyfile=key,
         admission=WebhookDispatcher(store),
         audit_path=audit_path,
+        flowcontrol=flowcontrol,
     ).start()
     teardown.append(api.stop)
     if debug_dir:

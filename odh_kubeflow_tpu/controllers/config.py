@@ -118,6 +118,11 @@ class Config:
     # (and over the higher-latency remote transport) it is the difference
     # between serial and pipelined reconciles
     max_concurrent_reconciles: int = 4
+    # status-write coalescing window (runtime/coalesce.py): adjacent status
+    # mirror patches for one object within this window batch into a single
+    # PATCH (leading-edge write-through, so steady state is unchanged).
+    # 0 disables coalescing entirely
+    status_coalesce_window_s: float = 0.05
 
     # extension controller / webhook (reference odh main.go + webhook consts)
     auth_proxy_image: str = "kube-rbac-proxy:latest"
@@ -225,6 +230,10 @@ class Config:
             c.slo_window_scale = max(1e-6, float(os.environ["SLO_WINDOW_SCALE"]))
         if os.environ.get("SLO_EVAL_PERIOD_S"):
             c.slo_eval_period_s = max(0.0, float(os.environ["SLO_EVAL_PERIOD_S"]))
+        if os.environ.get("STATUS_COALESCE_WINDOW_S"):
+            c.status_coalesce_window_s = max(
+                0.0, float(os.environ["STATUS_COALESCE_WINDOW_S"])
+            )
         if os.environ.get("CANARY_PERIOD_S"):
             c.canary_period_s = max(0.0, float(os.environ["CANARY_PERIOD_S"]))
         if os.environ.get("CANARY_TIMEOUT_S"):

@@ -949,11 +949,22 @@ class InferenceEndpointReconciler:
         status.url = self._route_path(ep) if phase == "Serving" else ""
         if status.to_dict() == before:
             return
+        spatch = status.to_dict()
+        spatch["readyReplicas"] = status.ready_replicas  # zero must be written
         try:
-            self.client.patch_status(
-                InferenceEndpoint, ep.metadata.namespace, ep.metadata.name,
-                status.to_dict(),
-            )
+            # coalesced when available (runtime/coalesce.py): one PATCH per
+            # endpoint per sync wave instead of one per watch event
+            coalescer = getattr(self.manager, "status_coalescer", None)
+            if coalescer is not None:
+                coalescer.patch_status(
+                    InferenceEndpoint, ep.metadata.namespace, ep.metadata.name,
+                    spatch,
+                )
+            else:
+                self.client.patch_status(
+                    InferenceEndpoint, ep.metadata.namespace, ep.metadata.name,
+                    spatch,
+                )
         except NotFoundError:
             pass  # deleted mid-reconcile
 

@@ -1169,11 +1169,20 @@ class TPUJobReconciler:
         status.tpu.mesh_ready = phase == "Running"
         if status.to_dict() == before:
             return
+        spatch = status.to_dict()
+        spatch["readyReplicas"] = status.ready_replicas  # zero must be written
         try:
-            self.client.patch_status(
-                TPUJob, job.metadata.namespace, job.metadata.name,
-                status.to_dict(),
-            )
+            # coalesced when available (runtime/coalesce.py): one PATCH per
+            # job per sync wave instead of one per watch event
+            coalescer = getattr(self.manager, "status_coalescer", None)
+            if coalescer is not None:
+                coalescer.patch_status(
+                    TPUJob, job.metadata.namespace, job.metadata.name, spatch
+                )
+            else:
+                self.client.patch_status(
+                    TPUJob, job.metadata.namespace, job.metadata.name, spatch
+                )
         except NotFoundError:
             pass  # deleted mid-reconcile
 

@@ -552,9 +552,18 @@ class NotebookReconciler:
         if "containerState" not in spatch:
             spatch["containerState"] = None  # explicit null deletes (pod gone)
         try:
-            self.client.patch_status(
-                Notebook, nb.metadata.namespace, nb.metadata.name, spatch
-            )
+            # route through the status coalescer when the manager carries one
+            # (runtime/coalesce.py): adjacent mirror patches in one sync wave
+            # batch into a single PATCH, owned zeros/nulls preserved
+            coalescer = getattr(self.manager, "status_coalescer", None)
+            if coalescer is not None:
+                coalescer.patch_status(
+                    Notebook, nb.metadata.namespace, nb.metadata.name, spatch
+                )
+            else:
+                self.client.patch_status(
+                    Notebook, nb.metadata.namespace, nb.metadata.name, spatch
+                )
         except NotFoundError:
             pass  # deleted mid-reconcile
 

@@ -35,6 +35,10 @@ def build_manager(
     config: Optional[Config] = None,
     leader_election: bool = False,
     http_get=None,
+    shard=None,
+    lease_duration: float = 15.0,
+    renew_period: float = 5.0,
+    register_webhook: bool = True,
 ) -> Manager:
     """Everything the two reference managers run, on one Manager.
 
@@ -43,16 +47,36 @@ def build_manager(
     speaking to an API server over the wire — in that mode admission runs
     server-side via MutatingWebhookConfiguration + the HTTPS webhook server
     (runtime/webhook_server.py; see serve_webhook), exactly the reference's
-    deployment shape (odh main.go:213-227)."""
+    deployment shape (odh main.go:213-227).
+
+    `shard` (runtime/manager.py ShardSpec) partitions the reconcile keyspace:
+    run one build_manager per shard (plus standbys with leader_election=True)
+    and each manager reconciles only the objects its shard owns, under its
+    own per-shard lease. In that wiring pass `register_webhook=False` for
+    every replica but one — the in-process admission chain is store-global,
+    and mutation must run once per request, not once per manager."""
     config = config or Config.from_env()
     mgr = Manager(
         store,
         leader_election=leader_election,
         leader_election_id="tpu-notebook-controller",
+        shard=shard,
+        lease_duration=lease_duration,
+        renew_period=renew_period,
     )
+    # status-write coalescing (runtime/coalesce.py): the notebook/endpoint/
+    # job mirrors route their patch_status through this, batching adjacent
+    # patches per object per window; rides the manager lifecycle so stop()
+    # flushes whatever is parked
+    from .runtime.coalesce import StatusCoalescer
+
+    mgr.status_coalescer = StatusCoalescer(
+        mgr.client, window_s=config.status_coalesce_window_s
+    )
+    mgr.add_service(mgr.status_coalescer)
     metrics = NotebookMetrics(mgr.metrics, mgr.client)
 
-    if hasattr(store, "register_webhook"):
+    if register_webhook and hasattr(store, "register_webhook"):
         NotebookWebhook(mgr.client, config).register(store)
     NotebookReconciler(mgr, config, metrics=metrics).setup()
     EventMirrorController(mgr).setup()

@@ -58,12 +58,23 @@ class Builder:
             self.name, reconciler, workers=self._workers, max_retries=self._max_retries
         )
         primary_gvk = self.manager.scheme.gvk_for(self._for)
+        # shard ownership filter (runtime/manager.py ShardSpec): a sharded
+        # manager sees every event through the shared informers but only
+        # enqueues PRIMARY keys its shard owns — owned/watched events are
+        # filtered on the key they map to, so the whole ownership decision
+        # is one hash of the reconcile target
+        shard = getattr(self.manager, "shard", None)
+
+        def owned_by_shard(ns: str, name: str) -> bool:
+            return shard is None or shard.owns(ns, name)
 
         def on_primary(ev_type: str, obj: dict, old: Optional[dict]) -> None:
             if self._for_predicate and not self._for_predicate(ev_type, obj, old):
                 return
             m = _meta(obj)
-            ctrl.enqueue(m.get("namespace", ""), m.get("name", ""))
+            ns, name = m.get("namespace", ""), m.get("name", "")
+            if owned_by_shard(ns, name):
+                ctrl.enqueue(ns, name)
 
         self.manager.informers.informer_for(self._for).add_handler(on_primary)
 
@@ -75,7 +86,10 @@ class Builder:
                     and ref.get("apiVersion", "").split("/")[0]
                     == primary_gvk.api_version.split("/")[0]
                 ):
-                    ctrl.enqueue(_meta(obj).get("namespace", ""), ref.get("name", ""))
+                    ns = _meta(obj).get("namespace", "")
+                    name = ref.get("name", "")
+                    if owned_by_shard(ns, name):
+                        ctrl.enqueue(ns, name)
 
         for cls in self._owns:
             self.manager.informers.informer_for(cls).add_handler(on_owned)
@@ -92,7 +106,8 @@ class Builder:
                 if predicate and not predicate(ev_type, obj, old):
                     return
                 for ns, name in mapper(obj):
-                    ctrl.enqueue(ns, name)
+                    if owned_by_shard(ns, name):
+                        ctrl.enqueue(ns, name)
 
             self.manager.informers.informer_for(cls).add_handler(on_watched)
 

@@ -80,6 +80,11 @@ class Controller:
         self.queue.shutdown()
 
     def _worker(self) -> None:
+        # lazy import: cluster/__init__ imports back into runtime, so the
+        # flowcontrol thread-local must be resolved at worker start, not at
+        # module import
+        from ..cluster.flowcontrol import flow_context
+
         while not self._stopped.is_set():
             req = self.queue.get()
             if req is None:
@@ -89,9 +94,14 @@ class Controller:
             try:
                 # log_context threads controller + object identity into every
                 # structured log record emitted below this frame
+                # flow_context stamps this worker's API traffic with the
+                # controller's identity for priority & fairness
+                # classification (sim client + wire header both read it)
                 with log_context(
                     controller=self.name, namespace=req.namespace, name=req.name
-                ), reconcile_duration_seconds.time(controller=self.name):
+                ), flow_context(self.name), reconcile_duration_seconds.time(
+                    controller=self.name
+                ):
                     result = self.reconciler(req)
                 self.reconcile_count += 1
                 self.rate_limiter.forget(req)

@@ -1,12 +1,21 @@
 """Manager: owns the client, informers, controllers, webhook registrations,
 leader election, and health/metrics — ctrl.NewManager + mgr.Start() analog
-(reference notebook-controller/main.go:87-148, odh main.go:117-245)."""
+(reference notebook-controller/main.go:87-148, odh main.go:117-245).
+
+Sharding (ISSUE 13): a Manager may own a `ShardSpec` — a deterministic
+hash partition of the object keyspace. Its builders then drop events for
+objects outside the shard, and its leader-election lease is per-shard
+(`{id}-shard-{i}`), so N manager replicas per shard give standby takeover
+within lease bounds while shards scale the reconcile budget horizontally
+(the NotebookOS shape: replicated control plane, one leader per partition)."""
 from __future__ import annotations
 
 import logging
 import threading
 import time
 import uuid
+import zlib
+from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from ..api.coordination import Lease, LeaseSpec
@@ -26,6 +35,30 @@ from .informer import InformerRegistry
 from .metrics import Registry, global_registry
 
 log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """A hash partition of the object keyspace: shard `index` of `count`.
+
+    Ownership is crc32("{ns}/{name}") % count — stable across processes and
+    restarts (no coordination needed to agree on the partition), uniform
+    enough that mixed-class fleets spread evenly. Every shard sees every
+    event (shared informers); non-owned keys are dropped at enqueue time
+    (runtime/builder.py), so the filter costs one hash per event."""
+
+    index: int
+    count: int
+
+    def __post_init__(self) -> None:
+        if self.count < 1 or not (0 <= self.index < self.count):
+            raise ValueError(f"invalid shard {self.index}/{self.count}")
+
+    def owns(self, namespace: str, name: str) -> bool:
+        if self.count == 1:
+            return True
+        key = f"{namespace}/{name}".encode()
+        return zlib.crc32(key) % self.count == self.index
 
 
 class LeaderElector:
@@ -157,9 +190,13 @@ class Manager:
         leader_election_id: str = "tpu-notebook-controller",
         metrics_registry: Optional[Registry] = None,
         cached_reads: bool = True,
+        shard: Optional[ShardSpec] = None,
+        lease_duration: float = 15.0,
+        renew_period: float = 5.0,
     ):
         self.store = store
         self.scheme = scheme
+        self.shard = shard
         self.informers = InformerRegistry(store, scheme)
         # controller-runtime's split client: reconciler reads serve from the
         # informer caches (mgr.GetClient()); api_reader bypasses the cache
@@ -208,8 +245,25 @@ class Manager:
         self.elector: Optional[LeaderElector] = None
         if leader_election:
             # the elector gets its OWN unfenced client: lease acquisition is
-            # the one write that must go through while we are NOT leader
-            self.elector = LeaderElector(Client(store, scheme), leader_election_id)
+            # the one write that must go through while we are NOT leader.
+            # It declares the leader-election flow, so the flowcontrol exempt
+            # level carries lease traffic even through an admission storm —
+            # failover must never queue behind the work it is failing over.
+            from ..cluster.flowcontrol import LEADER_ELECTION_FLOW
+
+            elector_client = Client(store, scheme)
+            elector_client.flow = LEADER_ELECTION_FLOW
+            lease_id = leader_election_id
+            if shard is not None and shard.count > 1:
+                # per-shard lease: shard i's leader and standbys contend for
+                # their own lock, independent of every other shard
+                lease_id = f"{leader_election_id}-shard-{shard.index}"
+            self.elector = LeaderElector(
+                elector_client,
+                lease_id,
+                lease_duration=lease_duration,
+                renew_period=renew_period,
+            )
             # fencing: once the lease lapses, every write through the
             # manager's client is refused — a partitioned ex-leader's
             # in-flight reconciles cannot mutate the cluster past its lease

@@ -316,6 +316,33 @@ fenced_writes_total = global_registry.counter(
     "Writes refused by leader-election fencing (lease not held)",
 )
 
+# ---- API priority & fairness (ISSUE 13): the apiserver-side flowcontrol
+# series, emitted by cluster/flowcontrol.py. One outcome-labelled counter so
+# an SLO can ratio dispatched against everything else ----
+
+flowcontrol_inflight = global_registry.gauge(
+    "flowcontrol_inflight",
+    "Requests currently executing (holding a seat), by priority level",
+    labels=("level",),
+)
+flowcontrol_queue_depth = global_registry.gauge(
+    "flowcontrol_queue_depth",
+    "Requests queued waiting for a seat, by priority level",
+    labels=("level",),
+)
+flowcontrol_requests_total = global_registry.counter(
+    "flowcontrol_requests_total",
+    "Flowcontrol admission outcomes (dispatched | rejected | timeout), by "
+    "priority level",
+    labels=("level", "outcome"),
+)
+flowcontrol_wait_seconds = global_registry.histogram(
+    "flowcontrol_wait_seconds",
+    "Time a request waited in its flow queue before dispatch, by priority level",
+    labels=("level",),
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30, 60),
+)
+
 # ---- controller-runtime-standard telemetry (ISSUE 2): the workqueue /
 # reconcile / informer series every controller dashboard expects, emitted by
 # runtime/workqueue.py, runtime/controller.py and runtime/informer.py ----

@@ -110,6 +110,16 @@ class ServingEndpoints:
                         "engine": engine.status() if engine is not None else None,
                         "alerts": alert_mgr.status() if alert_mgr is not None else None,
                     })
+                elif path == "/debug/flowcontrol":
+                    # API priority & fairness state: the FlowController the
+                    # manager's store carries (sim mode) — per-level seats,
+                    # inflight, queue depth, shed counts, p99 wait
+                    fc = getattr(
+                        getattr(serving.manager, "store", None), "flowcontrol", None
+                    )
+                    respond_json(
+                        {"levels": fc.summary() if fc is not None else None}
+                    )
                 elif path == "/debug/incidents":
                     rec = serving._recorder()
                     if "id" in query:
@@ -178,6 +188,8 @@ class ServingEndpoints:
             b"burn rates, alert state</li>"
             b'<li><a href="/debug/incidents">/debug/incidents</a> &mdash; '
             b"flight-recorder incident bundles (?id=)</li>"
+            b'<li><a href="/debug/flowcontrol">/debug/flowcontrol</a> &mdash; '
+            b"API priority &amp; fairness levels (seats, queue, shed)</li>"
             b'<li><a href="/healthz">/healthz</a></li>'
             b"</ul></body></html>\n"
         )

@@ -1,0 +1,190 @@
+"""Status-write coalescing (runtime/coalesce.py, ISSUE 13 satellite): the
+notebook/endpoint/job status mirrors batch adjacent PATCHes into one write
+per object per sync wave — without ever dropping owned zeros or explicit
+nulls (the PR 9 omitempty contract)."""
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.notebook import Notebook
+from odh_kubeflow_tpu.apimachinery import ForbiddenError, NotFoundError
+from odh_kubeflow_tpu.cluster import Client, Store
+from odh_kubeflow_tpu.runtime.coalesce import StatusCoalescer, merge_patches
+
+
+class RecordingClient:
+    """patch_status recorder standing in for the manager's fenced client."""
+
+    def __init__(self, error=None):
+        self.calls = []
+        self.error = error
+
+    def patch_status(self, cls, namespace, name, patch):
+        self.calls.append((cls, namespace, name, patch))
+        if self.error is not None:
+            raise self.error
+
+
+# ---------------------------------------------------------------------------
+# merge semantics: zeros and nulls are values
+# ---------------------------------------------------------------------------
+
+
+def test_merge_later_wins_recursively():
+    base = {"a": 1, "nest": {"x": 1, "y": 2}}
+    merge_patches(base, {"a": 2, "nest": {"y": 3, "z": 4}})
+    assert base == {"a": 2, "nest": {"x": 1, "y": 3, "z": 4}}
+
+
+def test_merge_preserves_owned_zero_and_explicit_null():
+    """The PR 9 omitempty contract survives coalescing: hostsReady: 0 and
+    containerState: None are VALUES, never dropped as 'empty'."""
+    base = {"readyReplicas": 1, "tpu": {"hostsReady": 2}, "containerState": {"running": {}}}
+    merge_patches(base, {"readyReplicas": 0, "tpu": {"hostsReady": 0},
+                         "containerState": None})
+    assert base["readyReplicas"] == 0
+    assert base["tpu"]["hostsReady"] == 0
+    assert base["containerState"] is None
+    assert "containerState" in base
+
+
+def test_merge_dict_replaces_scalar_and_vice_versa():
+    base = {"a": {"x": 1}, "b": 2}
+    merge_patches(base, {"a": 3, "b": {"y": 4}})
+    assert base == {"a": 3, "b": {"y": 4}}
+
+
+# ---------------------------------------------------------------------------
+# the write-rate regression: one PATCH per object per window
+# ---------------------------------------------------------------------------
+
+
+def test_burst_coalesces_to_leading_edge_plus_one_flush():
+    client = RecordingClient()
+    co = StatusCoalescer(client, window_s=0.15)
+    co.start()
+    try:
+        # 10 adjacent patches in one sync wave
+        co.patch_status(Notebook, "ns", "nb", {"readyReplicas": 1})
+        for i in range(2, 10):
+            co.patch_status(Notebook, "ns", "nb", {"readyReplicas": i % 2})
+        co.patch_status(Notebook, "ns", "nb",
+                        {"readyReplicas": 0, "containerState": None,
+                         "tpu": {"hostsReady": 0}})
+        # leading edge went through immediately (steady-state latency intact)
+        assert len(client.calls) == 1
+        assert client.calls[0][3] == {"readyReplicas": 1}
+        deadline = time.monotonic() + 5
+        while len(client.calls) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # ...and exactly ONE trailing flush carrying the merged batch
+        assert len(client.calls) == 2
+        merged = client.calls[1][3]
+        assert merged["readyReplicas"] == 0
+        assert merged["containerState"] is None  # explicit null survived
+        assert merged["tpu"] == {"hostsReady": 0}  # owned zero survived
+        assert co.writes == 2 and co.coalesced == 8
+    finally:
+        co.stop()
+
+
+def test_distinct_objects_do_not_coalesce_together():
+    client = RecordingClient()
+    co = StatusCoalescer(client, window_s=0.1)
+    co.start()
+    try:
+        co.patch_status(Notebook, "ns", "a", {"readyReplicas": 1})
+        co.patch_status(Notebook, "ns", "b", {"readyReplicas": 1})
+        assert len(client.calls) == 2  # both idle: both write through
+        assert {c[2] for c in client.calls} == {"a", "b"}
+    finally:
+        co.stop()
+
+
+def test_zero_window_writes_straight_through():
+    client = RecordingClient()
+    co = StatusCoalescer(client, window_s=0.0)
+    for i in range(5):
+        co.patch_status(Notebook, "ns", "nb", {"readyReplicas": i})
+    assert len(client.calls) == 5 and co.coalesced == 0
+
+
+def test_stop_flushes_pending():
+    client = RecordingClient()
+    co = StatusCoalescer(client, window_s=30.0)  # window far beyond the test
+    co.start()
+    co.patch_status(Notebook, "ns", "nb", {"readyReplicas": 1})
+    co.patch_status(Notebook, "ns", "nb", {"readyReplicas": 0})
+    assert len(client.calls) == 1
+    co.stop()  # must not wait 30s; flushes what's parked
+    assert len(client.calls) == 2
+    assert client.calls[1][3] == {"readyReplicas": 0}
+
+
+def test_fenced_flush_dropped_not_retried():
+    """Fence closed between park and flush: the ex-leader's coalesced write
+    is dropped (the new leader re-mirrors), never retried or raised."""
+    client = RecordingClient(error=ForbiddenError("write fenced"))
+    co = StatusCoalescer(client, window_s=0.0)
+    co.patch_status(Notebook, "ns", "nb", {"readyReplicas": 1})  # absorbed
+    assert len(client.calls) == 1
+    client2 = RecordingClient(error=NotFoundError("gone"))
+    co2 = StatusCoalescer(client2, window_s=0.0)
+    co2.patch_status(Notebook, "ns", "nb", {"readyReplicas": 1})  # absorbed
+    assert len(client2.calls) == 1
+
+
+def test_concurrent_patchers_one_flush():
+    """Racing mirror threads on one object still produce bounded writes:
+    leading edge + at most one flush per window."""
+    client = RecordingClient()
+    co = StatusCoalescer(client, window_s=0.2)
+    co.start()
+    try:
+        threads = [
+            threading.Thread(
+                target=co.patch_status,
+                args=(Notebook, "ns", "nb", {"readyReplicas": i % 2}),
+            )
+            for i in range(16)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        deadline = time.monotonic() + 5
+        while co.writes < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert co.writes <= 3  # leading edge + flush (+1 timing slack)
+        assert co.writes + co.coalesced == 16
+    finally:
+        co.stop()
+
+
+# ---------------------------------------------------------------------------
+# manager wiring
+# ---------------------------------------------------------------------------
+
+
+def test_build_manager_wires_coalescer_from_config():
+    from odh_kubeflow_tpu.controllers import Config
+    from odh_kubeflow_tpu.main import build_manager
+
+    store = Store()
+    config = Config(status_coalesce_window_s=0.03)
+    mgr = build_manager(store, config)
+    assert mgr.status_coalescer is not None
+    assert mgr.status_coalescer.window_s == 0.03
+    assert mgr.status_coalescer in mgr._services  # flushed at mgr.stop()
+    assert mgr.status_coalescer.client is mgr.client  # fenced client: fence
+    # rules apply to coalesced mirror writes exactly as to direct ones
+
+
+def test_status_coalesce_window_env_knob(monkeypatch):
+    from odh_kubeflow_tpu.controllers import Config
+
+    monkeypatch.setenv("STATUS_COALESCE_WINDOW_S", "0.2")
+    assert Config.from_env().status_coalesce_window_s == 0.2
+    monkeypatch.setenv("STATUS_COALESCE_WINDOW_S", "-1")
+    assert Config.from_env().status_coalesce_window_s == 0.0  # clamped

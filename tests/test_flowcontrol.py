@@ -1,0 +1,318 @@
+"""API priority & fairness (cluster/flowcontrol.py, ISSUE 13): flow-schema
+classification, bounded seats with per-flow FIFO queues, round-robin seat
+handover, queue-full/timeout shed via the 429+Retry-After idiom, the exempt
+level leader-election traffic rides, the typed Client's sim-mode admission
+gate, the metrics families, and the /debug/flowcontrol view.
+
+Deterministic tier-1 tests (marker: flowcontrol); the ci/faults.sh overload
+lane reruns these under REPEAT + RACECHECK=1 + INVCHECK=1.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.core import ConfigMap
+from odh_kubeflow_tpu.apimachinery import TooManyRequestsError
+from odh_kubeflow_tpu.cluster import Client, Store
+from odh_kubeflow_tpu.cluster.flowcontrol import (
+    LEADER_ELECTION_FLOW,
+    FlowController,
+    FlowSchema,
+    PriorityLevel,
+    current_flow,
+    flow_context,
+)
+from odh_kubeflow_tpu.runtime import Manager
+from odh_kubeflow_tpu.runtime import metrics as rm
+
+pytestmark = pytest.mark.flowcontrol
+
+
+def mk_cm(name, ns="flows"):
+    cm = ConfigMap()
+    cm.metadata.name = name
+    cm.metadata.namespace = ns
+    cm.data = {"k": "v"}
+    return cm
+
+
+def wait_for(fn, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.005)
+    raise AssertionError(f"timeout: {msg}")
+
+
+# ---------------------------------------------------------------------------
+# flow identity plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_flow_context_nests_and_restores():
+    assert current_flow() == ""
+    with flow_context("notebook"):
+        assert current_flow() == "notebook"
+        with flow_context("tpu-job"):
+            assert current_flow() == "tpu-job"
+        assert current_flow() == "notebook"
+    assert current_flow() == ""
+
+
+def test_flow_context_is_thread_local():
+    seen = {}
+
+    def worker():
+        seen["inner"] = current_flow()
+
+    with flow_context("notebook"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["inner"] == ""  # not inherited across threads
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+
+
+def test_classify_default_schemas():
+    fc = FlowController()
+    # leader-election traffic and Lease objects are exempt no matter what
+    assert fc.classify(LEADER_ELECTION_FLOW).name == "exempt"
+    assert fc.classify("anybody", kind="Lease").name == "exempt"
+    # node machinery -> system
+    assert fc.classify("kubelet", verb="write", kind="Pod").name == "system"
+    assert fc.classify("scheduler").name == "system"
+    # product reconcilers -> the protected workload class
+    for flow in ("notebook", "probe-status", "culling", "inference-endpoint"):
+        assert fc.classify(flow).name == "workload-high", flow
+    # batch: by controller flow AND by kind (an anonymous admission storm
+    # creating TPUJobs still contends in the batch budget)
+    assert fc.classify("tpu-job").name == "batch"
+    assert fc.classify("", verb="create", kind="TPUJob").name == "batch"
+    # unclassified -> catch-all
+    assert fc.classify("stranger", kind="ConfigMap").name == "default"
+
+
+def test_schema_first_match_precedence_and_validation():
+    fc = FlowController(
+        schemas=[
+            FlowSchema("narrow", "high", flows=("x",), verbs=("write",)),
+            FlowSchema("wide", "low", flows=("x",)),
+            FlowSchema("catch-all", "default"),
+        ],
+        levels=[
+            PriorityLevel("high", seats=2),
+            PriorityLevel("low", seats=2),
+            PriorityLevel("default", seats=2),
+        ],
+    )
+    assert fc.classify("x", verb="write").name == "high"
+    assert fc.classify("x", verb="read").name == "low"
+    assert fc.classify("y").name == "default"
+    with pytest.raises(ValueError):
+        FlowController(
+            schemas=[FlowSchema("bad", "nonexistent-level")],
+            levels=[PriorityLevel("default")],
+        )
+
+
+# ---------------------------------------------------------------------------
+# admission: seats, queueing, shed
+# ---------------------------------------------------------------------------
+
+
+def tiny_controller(seats=1, queue_length=2, timeout=0.2):
+    return FlowController(
+        schemas=[FlowSchema("catch-all", "default")],
+        levels=[PriorityLevel("default", seats=seats, queue_length=queue_length,
+                              queue_timeout_s=timeout)],
+    )
+
+
+def test_seats_queue_and_queue_full_shed():
+    fc = tiny_controller(seats=1, queue_length=1, timeout=5.0)
+    first = fc.admit("a")  # takes the only seat
+    granted = threading.Event()
+
+    def waiter():
+        with fc.admit("a"):
+            granted.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    wait_for(lambda: fc.summary()["default"]["queue_depth"] == 1, msg="queued")
+    # queue full: the NEXT request sheds immediately with Retry-After
+    with pytest.raises(TooManyRequestsError) as exc:
+        fc.admit("a")
+    assert exc.value.retry_after > 0
+    assert not granted.is_set()
+    first.release()  # freed seat goes to the queued waiter
+    assert granted.wait(2)
+    t.join(2)
+    s = fc.summary()["default"]
+    assert s["rejected"] == 1 and s["dispatched"] == 2 and s["queued"] == 1
+    assert s["inflight"] == 0 and s["queue_depth"] == 0
+
+
+def test_queue_timeout_sheds():
+    fc = tiny_controller(seats=1, queue_length=4, timeout=0.15)
+    hog = fc.admit("hog")
+    t0 = time.monotonic()
+    with pytest.raises(TooManyRequestsError):
+        fc.admit("late")
+    assert 0.1 <= time.monotonic() - t0 < 2.0
+    assert fc.summary()["default"]["timed_out"] == 1
+    hog.release()
+    # the timed-out waiter was removed from the queue: a fresh request gets
+    # the seat, it is not handed to a ghost
+    with fc.admit("fresh"):
+        pass
+    assert fc.summary()["default"]["inflight"] == 0
+
+
+def test_round_robin_across_flows():
+    """One hot flow must not monopolize a level: freed seats hand over
+    round-robin across flows, so order is A,B,A,A — not FIFO A,A,A,B."""
+    fc = tiny_controller(seats=1, queue_length=16, timeout=10.0)
+    hog = fc.admit("seed")
+    order = []
+    threads = []
+
+    def waiter(flow):
+        with fc.admit(flow):
+            order.append(flow)
+
+    for i, flow in enumerate(["A", "A", "A", "B"]):
+        t = threading.Thread(target=waiter, args=(flow,))
+        t.start()
+        threads.append(t)
+        wait_for(
+            lambda n=i: fc.summary()["default"]["queue_depth"] == n + 1,
+            msg=f"waiter {i} queued",
+        )
+    hog.release()
+    for t in threads:
+        t.join(5)
+    assert order == ["A", "B", "A", "A"]
+
+
+def test_exempt_level_never_queues_never_sheds():
+    fc = FlowController()
+    before = fc.summary()["exempt"]["dispatched"]
+    tickets = [fc.admit(LEADER_ELECTION_FLOW) for _ in range(50)]
+    s = fc.summary()["exempt"]
+    assert s["inflight"] == 50  # way past any seat budget, all admitted
+    assert s["rejected"] == 0 and s["timed_out"] == 0 and s["queue_depth"] == 0
+    assert s["dispatched"] - before == 50
+    for t in tickets:
+        t.release()
+    assert fc.summary()["exempt"]["inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the typed Client's sim-mode admission gate (store.flowcontrol)
+# ---------------------------------------------------------------------------
+
+
+def test_client_gates_through_store_flowcontrol():
+    store = Store()
+    store.flowcontrol = tiny_controller(seats=1, queue_length=0, timeout=0.05)
+    client = Client(store)
+    client.create(mk_cm("ok"))  # seat free: passes straight through
+    assert store.flowcontrol.summary()["default"]["dispatched"] >= 1
+
+    hog = store.flowcontrol.admit("hog")
+    # queue_length=0: every attempt sheds; the client's bounded 429 retry
+    # loop (MAX_THROTTLE_RETRIES) rides the Retry-After then surfaces it
+    retries0 = rm.client_retries_total.value(cause="throttle")
+    with pytest.raises(TooManyRequestsError):
+        client.get(ConfigMap, "flows", "ok")
+    assert rm.client_retries_total.value(cause="throttle") - retries0 == Client.MAX_THROTTLE_RETRIES
+    hog.release()
+    assert client.get(ConfigMap, "flows", "ok").data == {"k": "v"}
+
+
+def test_client_flow_override_rides_exempt_level():
+    """The elector's client sets flow='leader-election': its writes bypass a
+    saturated level entirely (failover never queues behind the storm)."""
+    store = Store()
+    store.flowcontrol = tiny_controller(seats=1, queue_length=0, timeout=0.05)
+    hog = store.flowcontrol.admit("hog")
+    try:
+        elector_client = Client(store)
+        elector_client.flow = LEADER_ELECTION_FLOW
+        elector_client.create(mk_cm("lease-ish"))  # admitted despite saturation
+        s = store.flowcontrol.summary()
+        assert s["exempt"]["rejected"] == 0 and s["exempt"]["dispatched"] >= 1
+    finally:
+        hog.release()
+
+
+def test_thread_local_flow_reaches_client_gate():
+    store = Store()
+    fc = FlowController()
+    store.flowcontrol = fc
+    client = Client(store)
+    before = fc.summary()["batch"]["dispatched"]
+    with flow_context("tpu-job"):
+        client.create(mk_cm("from-batch"))
+    assert fc.summary()["batch"]["dispatched"] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics families + /debug/flowcontrol
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.observability
+def test_flowcontrol_metrics_families_move():
+    rejected0 = rm.flowcontrol_requests_total.value(level="default", outcome="rejected")
+    dispatched0 = rm.flowcontrol_requests_total.value(level="default", outcome="dispatched")
+    fc = tiny_controller(seats=1, queue_length=0, timeout=0.05)
+    with fc.admit("a"):
+        with pytest.raises(TooManyRequestsError):
+            fc.admit("b")
+    assert rm.flowcontrol_requests_total.value(level="default", outcome="rejected") == rejected0 + 1
+    assert rm.flowcontrol_requests_total.value(level="default", outcome="dispatched") == dispatched0 + 1
+    assert rm.flowcontrol_inflight.value(level="default") == 0
+    text = rm.global_registry.render()
+    for family in (
+        "flowcontrol_inflight",
+        "flowcontrol_queue_depth",
+        "flowcontrol_requests_total",
+        "flowcontrol_wait_seconds_bucket",
+    ):
+        assert family in text, family
+
+
+@pytest.mark.observability
+def test_debug_flowcontrol_view():
+    import urllib.request
+
+    store = Store()
+    store.flowcontrol = FlowController()
+    with store.flowcontrol.admit("tpu-job"):
+        pass
+    mgr = Manager(store)
+    server = mgr.serve_endpoints(metrics_port=0, health_port=0, host="127.0.0.1")
+    try:
+        host, port = server.metrics_address
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/debug/flowcontrol", timeout=5
+        ) as resp:
+            payload = json.loads(resp.read().decode())
+        levels = payload["levels"]
+        assert set(levels) == {"exempt", "system", "workload-high", "batch", "default"}
+        assert levels["batch"]["dispatched"] >= 1
+        assert levels["exempt"]["exempt"] is True
+        # the index page links the view
+        with urllib.request.urlopen(f"http://{host}:{port}/debug/", timeout=5) as resp:
+            assert "/debug/flowcontrol" in resp.read().decode()
+    finally:
+        server.stop()

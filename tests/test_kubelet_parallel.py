@@ -1,0 +1,104 @@
+"""Parallel sim-kubelet pod bring-up (ISSUE 13 satellite): N pods across M
+nodes must reach Ready in roughly the longest per-node startup chain, not
+the serial sum of every pod's ready_after — the LOADTEST_r05 serial wall.
+
+The kubelet runs `workers` reconcile workers and caps concurrent startups at
+`max_starting_per_node` (the container runtime's parallel image-pull
+budget); a throttled pod's startup clock does NOT run while it waits for a
+slot."""
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.core import Container, Pod
+from odh_kubeflow_tpu.cluster import SimCluster
+from odh_kubeflow_tpu.cluster.kubelet import PodDecision
+
+NS = "bringup"
+READY_AFTER = 0.3
+
+
+def mk_bound_pod(name, node):
+    """A pod pre-bound to a node: the kubelet picks it up directly, no
+    scheduler involvement."""
+    pod = Pod()
+    pod.metadata.name = name
+    pod.metadata.namespace = NS
+    pod.spec.containers = [Container(name=name, image="jax:1")]
+    pod.spec.node_name = node
+    return pod
+
+
+def all_ready(cluster, names):
+    for name in names:
+        pod = cluster.client.get(Pod, NS, name)
+        if not (pod.status.phase == "Running" and pod.is_ready()):
+            return False
+    return True
+
+
+def test_fanout_beats_serial_sum():
+    cluster = SimCluster().start()
+    try:
+        cluster.add_pod_behavior(
+            lambda pod: PodDecision(ready_after=READY_AFTER)
+            if pod.metadata.namespace == NS
+            else None
+        )
+        nodes = ["node-a", "node-b", "node-c"]
+        names = [f"p-{i}" for i in range(24)]
+        serial_sum = len(names) * READY_AFTER  # 7.2s if bring-up were serial
+        t0 = time.monotonic()
+        for i, name in enumerate(names):
+            cluster.client.create(mk_bound_pod(name, nodes[i % len(nodes)]))
+        deadline = t0 + serial_sum
+        while time.monotonic() < deadline:
+            if all_ready(cluster, names):
+                break
+            time.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        assert all_ready(cluster, names), f"pods not all ready after {elapsed:.1f}s"
+        # 8 pods per node / max_starting_per_node=4 -> 2 startup waves per
+        # node, nodes in parallel: ~2*READY_AFTER plus scheduling slack.
+        # Anything near serial_sum means the fan-out regressed.
+        kubelet = cluster.kubelet
+        waves_per_node = -(-(len(names) // len(nodes)) // kubelet.max_starting_per_node)
+        expected = waves_per_node * READY_AFTER
+        assert elapsed < serial_sum / 2, (
+            f"bring-up took {elapsed:.2f}s (serial sum {serial_sum:.1f}s, "
+            f"expected ~{expected:.1f}s): parallel fan-out regressed"
+        )
+    finally:
+        cluster.stop()
+
+
+def test_per_node_start_budget_holds_clock():
+    """More pods than the per-node budget on ONE node: total time is the
+    number of waves times ready_after — proof the queued pods' clocks were
+    NOT running while they waited (otherwise all would be ready after
+    ~ready_after)."""
+    cluster = SimCluster().start()
+    try:
+        cluster.add_pod_behavior(
+            lambda pod: PodDecision(ready_after=READY_AFTER)
+            if pod.metadata.namespace == NS
+            else None
+        )
+        budget = cluster.kubelet.max_starting_per_node
+        names = [f"q-{i}" for i in range(2 * budget)]
+        t0 = time.monotonic()
+        for name in names:
+            cluster.client.create(mk_bound_pod(name, "solo-node"))
+        while time.monotonic() - t0 < 10:
+            if all_ready(cluster, names):
+                break
+            time.sleep(0.02)
+        elapsed = time.monotonic() - t0
+        assert all_ready(cluster, names)
+        # two full waves: the second wave's clocks only started once the
+        # first wave freed its slots
+        assert elapsed >= 2 * READY_AFTER - 0.05, (
+            f"{elapsed:.2f}s: throttled pods' startup clocks ran while queued"
+        )
+    finally:
+        cluster.stop()

@@ -1,0 +1,253 @@
+"""Apiserver overload resilience (ISSUE 13 satellite): the deterministic
+`apiserver_overload` fault schedule (429 bursts + latency injection) runs
+under a TPUJob admission storm against a flow-controlled control plane.
+
+Invariants the overload lane (ci/faults.sh) replays under REPEAT +
+RACECHECK=1 + INVCHECK=1:
+- the storm is shed at the batch priority level (rejected/timed_out move),
+- exempt-level (leader lease) traffic is NEVER starved — zero sheds while
+  renewals keep flowing through the storm,
+- the protected workload class is untouched,
+- zero silently-stuck objects: every job (storm jobs included) reaches
+  `succeeded`, every notebook reaches Ready, no controller thread dies.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.api.coordination import Lease
+from odh_kubeflow_tpu.api.core import ConfigMap, Container
+from odh_kubeflow_tpu.api.job import TPUJob
+from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
+from odh_kubeflow_tpu.apimachinery import TooManyRequestsError
+from odh_kubeflow_tpu.cluster import Client, SimCluster, Store
+from odh_kubeflow_tpu.cluster.faults import FaultInjector, apiserver_overload
+from odh_kubeflow_tpu.cluster.flowcontrol import (
+    FlowController,
+    PriorityLevel,
+    default_flow_schemas,
+    flow_context,
+)
+from odh_kubeflow_tpu.controllers import (
+    Config,
+    NotebookReconciler,
+    ProbeStatusController,
+    SuspendResumeController,
+    TPUJobReconciler,
+    constants as C,
+)
+from odh_kubeflow_tpu.controllers.job import STATE_SUCCEEDED
+from odh_kubeflow_tpu.probe import sim_agent_behavior
+from odh_kubeflow_tpu.runtime import Manager
+
+pytestmark = pytest.mark.overload
+
+NS = "overload"
+STEP_PER_CKPT = 30
+
+FAST = Config(
+    enable_culling=False,
+    suspend_enabled=True,
+    readiness_probe_period_s=0.15,
+    suspend_checkpoint_window_s=1.0,
+    resume_timeout_s=20.0,
+    reclaim_pending_grace_s=0.3,
+    job_checkpoint_window_s=2.0,
+    job_requeue_backoff_s=0.1,
+)
+
+
+def storm_flowcontrol():
+    """Default schemas over default levels, with the batch budget tightened
+    so a create storm contends deterministically (2 seats, 2-deep queues,
+    200ms queue patience)."""
+    return FlowController(
+        schemas=default_flow_schemas(),
+        levels=[
+            PriorityLevel("exempt", exempt=True),
+            PriorityLevel("system", seats=16, queue_length=64, queue_timeout_s=10.0),
+            PriorityLevel("workload-high", seats=12, queue_length=64,
+                          queue_timeout_s=10.0),
+            PriorityLevel("batch", seats=2, queue_length=2, queue_timeout_s=0.2),
+            PriorityLevel("default", seats=8, queue_length=32, queue_timeout_s=5.0),
+        ],
+    )
+
+
+def mk_job(name, steps=30, period=0.1):
+    job = TPUJob()
+    job.metadata.name = name
+    job.metadata.namespace = NS
+    job.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    job.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+    job.spec.steps = steps
+    job.spec.checkpoint_period_s = period
+    return job
+
+
+def mk_nb(name):
+    nb = Notebook()
+    nb.metadata.name = name
+    nb.metadata.namespace = NS
+    nb.spec.template.spec.containers = [Container(name=name, image="jax:1")]
+    return nb
+
+
+def wait_for(fn, timeout=60, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if fn():
+                return True
+        except TooManyRequestsError:
+            pass  # the injected overload also hits the test's own reads
+        time.sleep(0.05)
+    raise AssertionError(f"timeout: {msg}")
+
+
+def create_persistent(client, obj, attempts=60):
+    """Driver-side storm retry loop: a shed create is re-offered until the
+    level has room — the storm is slowed down, never lost."""
+    for _ in range(attempts):
+        try:
+            return client.create(obj)
+        except TooManyRequestsError:
+            time.sleep(0.05)
+    raise AssertionError(f"create never admitted: {obj.metadata.name}")
+
+
+def test_overload_storm_shed_at_batch_exempt_never_starved():
+    cluster = SimCluster().start()
+    fc = storm_flowcontrol()
+    cluster.store.flowcontrol = fc
+    cluster.add_tpu_pool("v5e", "v5e", "2x2", slices=4)
+    cluster.add_cpu_pool("cpu", nodes=2)
+    apiserver_overload(cluster.faults, seed=13)
+
+    steps = {}
+
+    def http_get(url, timeout=10.0):
+        if "/tpu/checkpoint" in url and "-learner-" in url:
+            name = url.split("//", 1)[1].split("-learner-", 1)[0]
+            steps[name] = steps.get(name, 0) + STEP_PER_CKPT
+            return 200, json.dumps({"saved": True, "step": steps[name]}).encode()
+        if "/tpu/checkpoint" in url:
+            return 200, json.dumps({"saved": True, "step": 1}).encode()
+        return cluster.http_get(url, timeout=timeout)
+
+    # leader-elected manager with a short lease: renewals tick through the
+    # whole storm, and every one of them must ride the exempt level
+    mgr = Manager(cluster.store, leader_election=True,
+                  leader_election_id="overload", lease_duration=2.0,
+                  renew_period=0.2)
+    NotebookReconciler(mgr, FAST).setup()
+    ProbeStatusController(mgr, FAST, http_get=http_get).setup()
+    SuspendResumeController(mgr, FAST, http_get=http_get).setup()
+    TPUJobReconciler(mgr, FAST, http_get=http_get).setup()
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    mgr.start(wait_for_leadership_timeout=5)
+    driver = cluster.client
+    try:
+        for i in range(2):
+            create_persistent(driver, mk_nb(f"nb-{i}"))
+        base_jobs = [f"job-{i}" for i in range(3)]
+        for name in base_jobs:
+            create_persistent(driver, mk_job(name))
+
+        # the admission storm: 6 anonymous TPUJob creates slam the batch
+        # level while both its seats are held — queue-full sheds are
+        # guaranteed, and the drivers must retry through them
+        storm_jobs = [f"storm-{i}" for i in range(6)]
+        hogs = [fc.admit("tpu-job") for _ in range(2)]
+        exempt_before = fc.summary()["exempt"]["dispatched"]
+        threads = [
+            threading.Thread(target=create_persistent, args=(driver, mk_job(n)))
+            for n in storm_jobs
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.6)  # the storm beats on a saturated level
+        for h in hogs:
+            h.release()
+        for t in threads:
+            t.join(30)
+            assert not t.is_alive(), "a storm driver wedged"
+
+        # shed happened, at the batch level and ONLY there
+        s = fc.summary()
+        assert s["batch"]["rejected"] + s["batch"]["timed_out"] > 0
+        assert s["workload-high"]["rejected"] == 0
+        assert s["workload-high"]["timed_out"] == 0
+        # exempt traffic kept flowing, with zero sheds: failover was never
+        # starved by the storm
+        assert s["exempt"]["rejected"] == 0 and s["exempt"]["timed_out"] == 0
+        assert s["exempt"]["dispatched"] > exempt_before
+        assert mgr.elector.is_leader.is_set()
+
+        # zero silently-stuck objects: every job — storm jobs included —
+        # completes once the overload budgets burn out
+        def job_state(name):
+            return driver.get(TPUJob, NS, name).metadata.annotations.get(
+                C.JOB_STATE_ANNOTATION, "")
+
+        for name in base_jobs + storm_jobs:
+            wait_for(lambda n=name: job_state(n) == STATE_SUCCEEDED,
+                     timeout=90, msg=f"{name} succeeded")
+        for i in range(2):
+            wait_for(
+                lambda i=i: driver.get(Notebook, NS, f"nb-{i}").status.ready_replicas >= 1,
+                msg=f"nb-{i} ready",
+            )
+        assert mgr.healthz(), "a controller thread died under overload"
+    finally:
+        mgr.stop()
+        cluster.stop()
+        cluster.faults.clear()
+
+
+def test_wire_overload_flow_header_delay_and_429_bursts():
+    """Wire mode: the X-Flow-Schema header classifies remote requests at the
+    ApiServer's admission point, the overload schedule's latency + 429-burst
+    rules fire at the HTTP boundary, and exempt Lease traffic is untouched."""
+    pytest.importorskip("cryptography")  # TLS fixture needs it (like test_transport)
+    from odh_kubeflow_tpu.cluster.remote_fixture import build_remote_stack
+
+    store = Store()
+    fc = FlowController()
+    teardown = []
+    try:
+        _, remote, _ = build_remote_stack(store, Config(), teardown, flowcontrol=fc)
+        store.faults = FaultInjector()  # after fixture setup: its own writes unthrottled
+        rules = apiserver_overload(store.faults, seed=5)
+        client = Client(remote)
+        batch_before = fc.summary()["batch"]["dispatched"]
+        with flow_context("tpu-job"):
+            for i in range(10):
+                cm = ConfigMap()
+                cm.metadata.name = f"wire-{i}"
+                cm.metadata.namespace = NS
+                create_persistent(client, cm)
+        lease = Lease()
+        lease.metadata.name = "wire-lease"
+        lease.metadata.namespace = "kube-system"
+        create_persistent(client, lease)
+
+        s = fc.summary()
+        # the thread-local flow traveled the wire as X-Flow-Schema and landed
+        # the creates on the batch level
+        assert s["batch"]["dispatched"] - batch_before >= 10
+        assert s["exempt"]["dispatched"] >= 1 and s["exempt"]["rejected"] == 0
+        # both halves of the schedule actually fired at the HTTP boundary
+        assert any(r.site == "apiserver.request" and r.action == "delay" and r.fired > 0
+                   for r in rules)
+        assert any(r.site == "apiserver.request" and r.error is not None and r.fired > 0
+                   for r in rules)
+        # nothing was lost to the bursts
+        for i in range(10):
+            assert client.get(ConfigMap, NS, f"wire-{i}").metadata.name == f"wire-{i}"
+    finally:
+        for fn in reversed(teardown):
+            fn()
