@@ -17,6 +17,8 @@
 #                                    # interleaving explorer only (ISSUE 8)
 #   ./ci/analysis.sh --jax           # the jaxlint family + JAXGUARD contract
 #                                    # tests only (ISSUE 12)
+#   ./ci/analysis.sh --deploy        # the deploylint family + DEPLOYGUARD
+#                                    # contract tests only (ISSUE 14)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -35,6 +37,41 @@ if [[ "${1:-}" == "--jax" ]]; then
         JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
             tests/test_analysis.py tests/test_jaxguard.py -q \
             -m "analysis and not slow" \
+            -p no:cacheprovider -p no:randomly
+    fi
+    exit 0
+fi
+
+if [[ "${1:-}" == "--deploy" ]]; then
+    # the deployment-surface conformance lane (ISSUE 14): the four deploylint
+    # checkers package-wide — RBAC coverage (verbs used vs granted, both
+    # directions), CRD schema drift against the committed manifests, the env
+    # contract (every os.environ read resolves to a declared ENV_CONTRACT
+    # knob), flow-schema coverage (every flow classifies non-default, every
+    # served webhook path is registered) — plus the committed-manifest
+    # regeneration gate and the deploylint/DEPLOYGUARD contract tests.
+    # When a DEPLOYGUARD surface artifact exists (a faults.sh DEPLOYGUARD=1
+    # iteration dumps one via DEPLOYGUARD_SURFACE_OUT), the rbac-coverage
+    # checker consumes it for runtime-confident stale-rule findings.
+    SURFACE_ARGS=()
+    if [[ -n "${DEPLOY_SURFACE:-}" && -f "${DEPLOY_SURFACE:-}" ]]; then
+        echo "== deploylint: using runtime surface artifact ${DEPLOY_SURFACE} =="
+        SURFACE_ARGS=(--deploy-surface "$DEPLOY_SURFACE")
+    fi
+    echo "== deploylint static pass (rbac/crd-drift/env-contract/flow-schema) =="
+    python -m odh_kubeflow_tpu.analysis \
+        --check rbac-coverage --check crd-schema-drift \
+        --check env-contract --check flow-schema-coverage \
+        "${SURFACE_ARGS[@]}" odh_kubeflow_tpu
+    echo "== pragma budget gate =="
+    python -m odh_kubeflow_tpu.analysis --pragma-gate ci/pragma_allowlist.txt
+    echo "== committed-manifest regeneration gate =="
+    ./ci/build_manifests.sh --check
+    if python -m pytest --version >/dev/null 2>&1; then
+        echo "== deploylint/deployguard contract tests =="
+        JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest \
+            tests/test_analysis.py tests/test_deployguard.py -q \
+            -m "deploylint and not slow" \
             -p no:cacheprovider -p no:randomly
     fi
     exit 0

@@ -1,8 +1,37 @@
 #!/usr/bin/env bash
 # Build-validate every overlay (the reference's ci/kustomize.sh: kustomize
 # build each config tree and fail on error).
+#
+#   ./ci/build_manifests.sh          # build-validate all overlays
+#   ./ci/build_manifests.sh --check  # additionally regenerate the full tree
+#                                    # into a temp dir and diff it against the
+#                                    # committed deploy/ — non-mutating (unlike
+#                                    # generate_manifests.sh, which rewrites
+#                                    # the working tree and leans on git), so
+#                                    # it is safe mid-edit and in a dirty tree
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--check" ]]; then
+    TMP="$(mktemp -d)"
+    trap 'rm -rf "$TMP"' EXIT
+    python -m odh_kubeflow_tpu.deploy generate --root "$TMP" \
+        --params deploy/params.env >/dev/null
+    rc=0
+    while IFS= read -r -d '' gen; do
+        rel="${gen#"$TMP"/}"
+        if ! diff -u "deploy/${rel}" "$gen" >/dev/null 2>&1; then
+            echo "ERROR: deploy/${rel} drifted from the generators:" >&2
+            diff -u "deploy/${rel}" "$gen" >&2 || true
+            rc=1
+        fi
+    done < <(find "$TMP" -type f -print0 | sort -z)
+    if [[ $rc -ne 0 ]]; then
+        echo "Run: python -m odh_kubeflow_tpu.deploy generate --root deploy" >&2
+        exit 1
+    fi
+    echo "deploy/ manifests match the generators"
+fi
 
 for overlay in base standalone gke dev; do
   echo "--- building overlay: ${overlay}"

@@ -108,6 +108,14 @@ echo "=== serving lane: JAXGUARD=1 iteration ==="
 JAXGUARD=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
+# ...and one with the deployment-surface guard armed (utils/deployguard.py,
+# ISSUE 14): every typed-client call attributes (flow, verb, kind) and a
+# manager-flow request exceeding the declared RBAC — or lease traffic
+# misattributed onto a workload flow — raises RBACDriftError AT the call
+echo "=== serving lane: DEPLOYGUARD=1 iteration ==="
+DEPLOYGUARD=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
 # job lane (ISSUE 10): the gang-scheduled TPUJob machine under faults —
 # host preemption mid-Running (checkpoint-preempt-requeue, resume from the
 # acked step), the reclaimer taking a batch slice for an interactive
@@ -135,6 +143,10 @@ echo "=== job lane: JAXGUARD=1 iteration ==="
 JAXGUARD=1 python -m pytest tests/test_job.py -q -m "job and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
+echo "=== job lane: DEPLOYGUARD=1 iteration ==="
+DEPLOYGUARD=1 python -m pytest tests/test_job.py -q -m "job and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
 # overload lane (ISSUE 13): the apiserver_overload schedule (429 bursts +
 # latency injection + store throttles) under a TPUJob admission storm
 # against the flow-controlled, sharded control plane — asserts the storm is
@@ -158,4 +170,17 @@ INVCHECK=1 python -m pytest tests/test_overload.py tests/test_sharding.py tests/
     -q -m "(overload or flowcontrol) and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, +1 jaxguard on serving/job, incl. slice chaos + pool churn + serving + job + overload) ==="
+# the overload lane's DEPLOYGUARD=1 iteration doubles as the surface
+# recorder: the shard-failover storm exercises the widest (flow, verb, kind)
+# surface in the suite, and the dumped artifact feeds
+# `ci/analysis.sh --deploy` (DEPLOY_SURFACE=...) for runtime-confident
+# stale-RBAC findings. Misattributed lease writes after failover — a lease
+# renewal issued from a workload flow instead of the elector's exempt
+# client — are a hard RBACDriftError here, not a silent fairness leak.
+echo "=== overload lane: DEPLOYGUARD=1 iteration (surface artifact) ==="
+DEPLOYGUARD=1 DEPLOYGUARD_SURFACE_OUT="${DEPLOYGUARD_SURFACE_OUT:-}" \
+    python -m pytest tests/test_overload.py tests/test_sharding.py tests/test_flowcontrol.py \
+    -q -m "(overload or flowcontrol) and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, +1 jaxguard +1 deployguard on serving/job/overload, incl. slice chaos + pool churn + serving + job + overload) ==="

@@ -17,6 +17,13 @@ rc=0
 echo "== operator-lint (ci/analysis.sh) =="
 ./ci/analysis.sh || rc=1
 
+# deployment-surface conformance (ISSUE 14): the deploylint checkers also run
+# in the default pass above; this lane adds the committed-manifest
+# regeneration gate (build_manifests.sh --check) and the deploylint/
+# DEPLOYGUARD contract tests
+echo "== deploylint (ci/analysis.sh --deploy) =="
+./ci/analysis.sh --deploy || rc=1
+
 if python -m ruff --version >/dev/null 2>&1; then
     echo "== ruff check =="
     python -m ruff check odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py || rc=1

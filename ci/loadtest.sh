@@ -21,7 +21,12 @@ cd "$(dirname "$0")/.."
 TIER="${LOADTEST_TIER:-200}"
 export PYTHONHASHSEED="${PYTHONHASHSEED:-0}"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# deployment-surface guard (ISSUE 14): the tier runs armed, so a lease write
+# misattributed onto a workload flow after the shard-leader kill — or any
+# request exceeding the declared RBAC — fails the tier at the offending call
+# instead of leaking into the fairness accounting
+export DEPLOYGUARD="${DEPLOYGUARD:-1}"
 
-echo "=== loadtest lane: ${TIER}-object tier ==="
+echo "=== loadtest lane: ${TIER}-object tier (DEPLOYGUARD=$DEPLOYGUARD) ==="
 python loadtest/tiers.py --objects "$TIER" "$@"
 echo "=== loadtest lane: ${TIER}-object tier passed its SLO verdict ==="

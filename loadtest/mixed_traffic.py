@@ -324,6 +324,10 @@ def run(args) -> None:
 
 
 def main() -> None:
+    # deployment-surface guard (ISSUE 14): the driver always runs armed
+    # (DEPLOYGUARD=0 opts out) — a request escaping its declared flow/RBAC
+    # surface fails the lane at the offending call, not as a fairness leak
+    os.environ.setdefault("DEPLOYGUARD", "1")
     ap = argparse.ArgumentParser()
     ap.add_argument("--notebooks", type=int, default=3)
     ap.add_argument("--jobs", type=int, default=1,

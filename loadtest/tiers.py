@@ -570,6 +570,12 @@ def run(args) -> None:
 
 
 def main() -> None:
+    # deployment-surface guard (ISSUE 14): the tier always runs armed
+    # (DEPLOYGUARD=0 opts out) — a shed-path or standby-takeover write that
+    # escapes its declared flow/RBAC surface (a lease write misattributed
+    # onto a workload flow after the shard failover, say) is a hard
+    # RBACDriftError at the call, not a silent fairness leak
+    os.environ.setdefault("DEPLOYGUARD", "1")
     ap = argparse.ArgumentParser()
     ap.add_argument("--objects", type=int, default=200, choices=(200, 500),
                     help="tier size: 200 (CI lane) or 500 (slow tier)")

@@ -28,6 +28,16 @@ invariants the generic linters cannot know about:
                        regions (`hotregions.py`), buffer-donation
                        discipline, and collective-axis sanity
                        (checkers/jaxlint.py)
+- ``rbac-coverage`` / ``crd-schema-drift`` / ``env-contract`` /
+  ``flow-schema-coverage``  the deploylint family: client calls vs the
+                       declared RBAC (both directions, with an optional
+                       runtime surface artifact), committed CRD manifests
+                       vs the generators, os.environ reads vs the
+                       ENV_CONTRACT registry vs the manifests, and
+                       flow_context/webhook literals vs the committed
+                       FlowSchemas/webhook config — all through the shared
+                       deployment-surface contract (`deploysurface.py`)
+                       (checkers/deploylint.py)
 
 Intentional exceptions are recorded inline with ``# lint: disable=<check>``
 pragmas (comma-separated check names, or ``all``) and budgeted in
@@ -37,6 +47,8 @@ tooling — the instrumented lock + cache write barrier that turns chaos runs
 into race runs (`utils/racecheck.py`), the INVCHECK store-write invariant
 monitor (`utils/invcheck.py`), the JAXGUARD compile/transfer/donation guard
 (`utils/jaxguard.py`, sharing `hotregions.py` with the jaxlint checkers),
+the DEPLOYGUARD RBAC/flow-identity guard (`utils/deployguard.py`, sharing
+`deploysurface.py` with the deploylint checkers),
 and the systematic interleaving explorer (`explore.py`) — shares the
 machine/region specs with the static checkers.
 """

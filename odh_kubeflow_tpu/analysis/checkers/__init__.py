@@ -6,6 +6,12 @@ from typing import List
 from ..framework import Checker
 from .cache_mutation import CacheMutationChecker
 from .conventions import AnnotationConventionChecker, MetricConventionChecker
+from .deploylint import (
+    CrdSchemaDriftChecker,
+    EnvContractChecker,
+    FlowSchemaCoverageChecker,
+    RbacCoverageChecker,
+)
 from .exceptions import SwallowedExceptionChecker
 from .jaxlint import (
     DonationDisciplineChecker,
@@ -35,4 +41,11 @@ def make_checkers() -> List[Checker]:
         HostTransferChecker(),
         DonationDisciplineChecker(),
         PsumAxisChecker(),
+        # the deploylint family (ISSUE 14): deployment-surface conformance
+        # against the analysis/deploysurface.py contract (runtime twin:
+        # utils/deployguard.py)
+        RbacCoverageChecker(),
+        CrdSchemaDriftChecker(),
+        EnvContractChecker(),
+        FlowSchemaCoverageChecker(),
     ]

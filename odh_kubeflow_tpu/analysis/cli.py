@@ -19,6 +19,12 @@
         --check psum-axis odh_kubeflow_tpu
                                     # the jaxlint data-plane family
                                     # (ci/analysis.sh --jax lane, ISSUE 12)
+    python -m odh_kubeflow_tpu.analysis --check rbac-coverage \
+        --check crd-schema-drift --check env-contract \
+        --check flow-schema-coverage [--deploy-surface surface.json] \
+        odh_kubeflow_tpu            # the deploylint deployment-surface
+                                    # family (ci/analysis.sh --deploy lane,
+                                    # ISSUE 14)
 
 Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
 """
@@ -226,6 +232,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "review)",
     )
     parser.add_argument(
+        "--deploy-surface", metavar="ARTIFACT",
+        help="JSON surface artifact recorded by DEPLOYGUARD "
+        "(DEPLOYGUARD_SURFACE_OUT) — gives rbac-coverage runtime confidence "
+        "when flagging stale rules",
+    )
+    parser.add_argument(
         "--machines-doc", action="store_true",
         help="render the state-machine specs (analysis/machines.py) as the "
         "markdown contract ARCHITECTURE.md embeds",
@@ -279,6 +291,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 LockOrderChecker() if c.name == "lock-order" else c
                 for c in checkers
             ]
+
+    if args.deploy_surface:
+        import json
+
+        from .deploysurface import surface_tuples_from_artifact
+
+        surface = surface_tuples_from_artifact(
+            json.loads(Path(args.deploy_surface).read_text())
+        )
+        for c in checkers:
+            if c.name == "rbac-coverage":
+                c.surface = surface  # type: ignore[attr-defined]
 
     findings = run_analysis(
         paths, checkers=checkers, include_suppressed=args.include_suppressed

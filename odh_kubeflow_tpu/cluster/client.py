@@ -17,6 +17,7 @@ from ..apimachinery import (
     TooManyRequestsError,
     default_scheme,
 )
+from ..utils import deployguard
 from .store import Store
 
 T = TypeVar("T", bound=KubeObject)
@@ -62,8 +63,21 @@ class Client:
             fenced_writes_total.inc()
             raise ForbiddenError("write fenced: leader lease not held")
 
-    def _call(self, fn: Callable[[], T], write: bool = False, kind: str = "") -> T:
+    def _call(
+        self,
+        fn: Callable[[], T],
+        write: bool = False,
+        kind: str = "",
+        method: str = "",
+    ) -> T:
         """Run a store op, honoring 429 Retry-After with bounded retries."""
+        # DEPLOYGUARD (utils/deployguard.py): when armed, every call reports
+        # its (flow, method, kind) BEFORE dispatch — a request exceeding the
+        # declared RBAC for a manager flow raises RBACDriftError right here,
+        # at the offending call. Off: one attribute check, nothing else.
+        guard = deployguard.ACTIVE
+        if guard is not None and method:
+            guard.observe(self._flow(), method, kind)
         # API priority & fairness, sim mode: a Store carrying a FlowController
         # (cluster/flowcontrol.py) admits every typed-client op at the
         # caller's priority level before it reaches the store — the
@@ -132,6 +146,7 @@ class Client:
             lambda: self.store.create_raw(payload),
             write=True,
             kind=payload.get("kind", ""),
+            method="create",
         )
         return self._decode(type(obj), out)
 
@@ -140,7 +155,9 @@ class Client:
         return self._decode(
             cls,
             self._call(
-                lambda: self.store.get_raw(av, kind, namespace, name), kind=kind
+                lambda: self.store.get_raw(av, kind, namespace, name),
+                kind=kind,
+                method="get",
             ),
         )
 
@@ -158,6 +175,7 @@ class Client:
                     av, kind, namespace=namespace, label_selector=labels
                 ),
                 kind=kind,
+                method="list",
             )
         ]
 
@@ -168,6 +186,7 @@ class Client:
             lambda: self.store.update_raw(payload),
             write=True,
             kind=payload.get("kind", ""),
+            method="update",
         )
         return self._decode(type(obj), out)
 
@@ -178,6 +197,7 @@ class Client:
             lambda: self.store.update_raw(payload, subresource="status"),
             write=True,
             kind=payload.get("kind", ""),
+            method="update_status",
         )
         return self._decode(type(obj), out)
 
@@ -190,6 +210,7 @@ class Client:
                 lambda: self.store.patch_raw(av, kind, namespace, name, patch),
                 write=True,
                 kind=kind,
+                method="patch",
             ),
         )
 
@@ -208,6 +229,7 @@ class Client:
                 ),
                 write=True,
                 kind=kind,
+                method="patch_status",
             ),
         )
 
@@ -218,6 +240,7 @@ class Client:
             lambda: self.store.delete_raw(av, kind, namespace, name),
             write=True,
             kind=kind,
+            method="delete",
         )
 
 

@@ -55,25 +55,41 @@ def cluster_role() -> Dict[str, Any]:
     two ClusterRoles (notebook-controller/config/rbac/role.yaml + odh
     config/rbac/role.yaml), plus the TPU-native additions (nodes read for
     topology discovery; leases for leader election)."""
+    # Every rule below is held against the code by the rbac-coverage checker
+    # (analysis/checkers/deploylint.py) and, armed, by DEPLOYGUARD at the
+    # offending call: verbs the code issues but a rule omits AND rules
+    # nothing exercises both fail CI. Granted-but-unexercised rules that the
+    # deployed shape still needs live in deploysurface.RBAC_EXEMPTIONS.
     rules: List[Dict[str, Any]] = [
         {
             "apiGroups": ["kubeflow.org"],
-            "resources": [
-                "notebooks",
-                "notebooks/status",
-                "notebooks/finalizers",
-                "inferenceendpoints",
-                "inferenceendpoints/status",
-                "inferenceendpoints/finalizers",
-                "tpujobs",
-                "tpujobs/status",
-                "tpujobs/finalizers",
-            ],
+            "resources": ["notebooks", "inferenceendpoints", "tpujobs"],
             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
         },
         {
+            "apiGroups": ["kubeflow.org"],
+            "resources": [
+                "notebooks/status",
+                "inferenceendpoints/status",
+                "tpujobs/status",
+            ],
+            "verbs": ["get", "update", "patch"],
+        },
+        {
+            # OwnerReferencesPermissionEnforcement: setting ownerRefs with
+            # blockOwnerDeletion needs finalizers update even though the code
+            # writes finalizers through the main resource
+            "apiGroups": ["kubeflow.org"],
+            "resources": [
+                "notebooks/finalizers",
+                "inferenceendpoints/finalizers",
+                "tpujobs/finalizers",
+            ],
+            "verbs": ["update"],
+        },
+        {
             "apiGroups": ["apps"],
-            "resources": ["statefulsets", "deployments"],
+            "resources": ["statefulsets"],
             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
         },
         {
@@ -88,7 +104,13 @@ def cluster_role() -> Dict[str, Any]:
             ],
             "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
         },
-        {"apiGroups": [""], "resources": ["nodes"], "verbs": ["get", "list", "watch"]},
+        {
+            # read for topology discovery; update for the slice-pool's node
+            # cordon/annotation writes (cluster/slicepool.py)
+            "apiGroups": [""],
+            "resources": ["nodes"],
+            "verbs": ["get", "list", "watch", "update"],
+        },
         {
             "apiGroups": ["networking.k8s.io"],
             "resources": ["networkpolicies"],
@@ -110,9 +132,18 @@ def cluster_role() -> Dict[str, Any]:
             "verbs": ["create"],
         },
         {
+            # the extension controller reads the namespace DSPA to decide
+            # pipeline wiring (controllers/extension.py)
+            "apiGroups": ["datasciencepipelinesapplications.opendatahub.io"],
+            "resources": ["datasciencepipelinesapplications"],
+            "verbs": ["get"],
+        },
+        {
+            # leader election: the elector only ever gets/creates/updates its
+            # Lease (runtime/manager.py)
             "apiGroups": ["coordination.k8s.io"],
             "resources": ["leases"],
-            "verbs": ["get", "list", "watch", "create", "update", "patch", "delete"],
+            "verbs": ["get", "create", "update"],
         },
     ]
     return {

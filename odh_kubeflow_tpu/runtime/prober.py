@@ -132,6 +132,7 @@ class CanaryProber:
         the very availability it measures."""
         from ..api.notebook import Notebook
         from ..apimachinery import NotFoundError
+        from ..cluster.flowcontrol import flow_context
 
         client = self.manager.client
         self._seq += 1
@@ -139,27 +140,33 @@ class CanaryProber:
         t0 = self.clock()
         result = "error"
         latency = 0.0
-        try:
-            client.create(self._make_canary(name))
-            deadline = t0 + self.timeout_s
-            result = "timeout"
-            while self.clock() < deadline and not self._stop.is_set():
-                try:
-                    nb = client.get(Notebook, self.namespace, name)
-                except NotFoundError:
-                    nb = None
-                if nb is not None and self._ready(nb):
-                    latency = self.clock() - t0
-                    result = "ok"
-                    break
-                time.sleep(0.02)
-        finally:
+        # the prober runs outside any controller worker loop, so it must
+        # claim its flow identity itself — without this the canary's
+        # create/get/delete would classify onto the default PriorityLevel
+        # and an overload could shed the very probe measuring it
+        # (found by the flow-schema-coverage checker)
+        with flow_context("canary"):
             try:
-                client.delete(Notebook, self.namespace, name)
-            except NotFoundError:
-                pass
-            except Exception:
-                log.exception("canary cleanup for %s failed", name)
+                client.create(self._make_canary(name))
+                deadline = t0 + self.timeout_s
+                result = "timeout"
+                while self.clock() < deadline and not self._stop.is_set():
+                    try:
+                        nb = client.get(Notebook, self.namespace, name)
+                    except NotFoundError:
+                        nb = None
+                    if nb is not None and self._ready(nb):
+                        latency = self.clock() - t0
+                        result = "ok"
+                        break
+                    time.sleep(0.02)
+            finally:
+                try:
+                    client.delete(Notebook, self.namespace, name)
+                except NotFoundError:
+                    pass
+                except Exception:
+                    log.exception("canary cleanup for %s failed", name)
         if (
             result == "timeout"
             and self._stop.is_set()

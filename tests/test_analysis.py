@@ -33,6 +33,13 @@ from odh_kubeflow_tpu.analysis.checkers.jaxlint import (
     PsumAxisChecker,
     RetraceHazardChecker,
 )
+from odh_kubeflow_tpu.analysis.checkers.deploylint import (
+    CrdSchemaDriftChecker,
+    EnvContractChecker,
+    FlowSchemaCoverageChecker,
+    RbacCoverageChecker,
+    make_deploylint_checkers,
+)
 from odh_kubeflow_tpu.analysis.checkers.machine_conformance import (
     MachineConformanceChecker,
 )
@@ -43,6 +50,7 @@ from odh_kubeflow_tpu.analysis.framework import (
     render_pragma_allowlist,
 )
 from odh_kubeflow_tpu.analysis.metric_rules import check_metric, check_registry
+from odh_kubeflow_tpu.controllers.config import EnvKnob
 from odh_kubeflow_tpu.utils import racecheck
 
 pytestmark = pytest.mark.analysis
@@ -916,6 +924,245 @@ def test_psum_axis_passes_declared_axes_including_defaults():
 def test_psum_axis_silent_without_any_declaration():
     # no mesh axes declared anywhere in the scanned set: no basis to judge
     assert run_on_source(PSUM_NO_DECLARATION, [PsumAxisChecker()]) == []
+
+
+# ---------------------------------------------------------------------------
+# deploylint (ISSUE 14): fixture twins for the deployment-surface family.
+# Paths matter here — rbac-coverage only attributes manager modules, and the
+# generator/flowcontrol/main fixtures arm their checkers by path.
+# ---------------------------------------------------------------------------
+
+MANAGER_PATH = "odh_kubeflow_tpu/controllers/fixture.py"
+
+RBAC_BAD = '''
+class R:
+    def reconcile(self):
+        ns = Namespace()
+        self.client.create(ns)
+'''
+
+RBAC_CLEAN = '''
+class R:
+    def reconcile(self):
+        cm = self.client.get(ConfigMap, "ns", "n")
+        self.client.update(cm)
+'''
+
+
+@pytest.mark.deploylint
+def test_rbac_coverage_flags_ungranted_verb_and_passes_clean_twin():
+    findings = run_on_source(
+        RBAC_BAD, [RbacCoverageChecker()], path=MANAGER_PATH
+    )
+    assert checks_of(findings) == {"rbac-coverage"}
+    assert "Namespace" in findings[0].message and "create" in findings[0].message
+    assert run_on_source(
+        RBAC_CLEAN, [RbacCoverageChecker()], path=MANAGER_PATH
+    ) == []
+
+
+@pytest.mark.deploylint
+def test_rbac_coverage_only_attributes_manager_modules():
+    # the same ungranted call in a sim-actor module carries another identity
+    assert run_on_source(
+        RBAC_BAD, [RbacCoverageChecker()],
+        path="odh_kubeflow_tpu/cluster/kubelet.py",
+    ) == []
+
+
+@pytest.mark.deploylint
+def test_rbac_coverage_flags_stale_rule_and_surface_clears_it():
+    def stale_findings(surface):
+        checker = RbacCoverageChecker()
+        checker.rbac_override = {("", "namespaces"): frozenset({"delete"})}
+        checker.force_stale = True
+        checker.surface = surface
+        # no client traffic at all: the granted rule is exercised by nothing
+        return run_on_source("x = 1", [checker], path=MANAGER_PATH)
+
+    findings = stale_findings(None)
+    assert len(findings) == 1 and "stale RBAC" in findings[0].message
+    # a runtime surface artifact proving the rule IS exercised clears it
+    assert stale_findings({("notebook", "delete", "Namespace", "")}) == []
+
+
+CRDGEN_PATH = "odh_kubeflow_tpu/deploy/crdgen.py"
+
+
+@pytest.mark.deploylint
+def test_crd_schema_drift_passes_the_committed_tree():
+    checker = CrdSchemaDriftChecker()
+    assert run_on_source("", [checker], path=CRDGEN_PATH) == []
+
+
+@pytest.mark.deploylint
+def test_crd_schema_drift_flags_a_doctored_manifest(tmp_path):
+    import pathlib
+
+    import yaml
+
+    import odh_kubeflow_tpu
+
+    committed = (
+        pathlib.Path(odh_kubeflow_tpu.__file__).parent.parent
+        / "deploy" / "base" / "manifests.yaml"
+    )
+    docs = list(yaml.safe_load_all(committed.read_text()))
+    for doc in docs:
+        if (
+            isinstance(doc, dict)
+            and doc.get("kind") == "CustomResourceDefinition"
+            and doc["metadata"]["name"].startswith("notebooks.")
+        ):
+            doc["spec"]["scope"] = "Cluster"
+    doctored = tmp_path / "manifests.yaml"
+    doctored.write_text(yaml.safe_dump_all(docs))
+
+    checker = CrdSchemaDriftChecker()
+    checker.manifests_path = str(doctored)
+    findings = run_on_source("", [checker], path=CRDGEN_PATH)
+    assert findings and "drifted" in findings[0].message
+    assert "spec.scope" in findings[0].message
+
+
+@pytest.mark.deploylint
+def test_crd_schema_drift_flags_a_missing_committed_tree(tmp_path):
+    checker = CrdSchemaDriftChecker()
+    checker.manifests_path = str(tmp_path / "nope.yaml")
+    findings = run_on_source("", [checker], path=CRDGEN_PATH)
+    assert findings and "missing" in findings[0].message
+
+
+ENV_BAD = '''
+import os
+token = os.environ.get("UNDECLARED_TOKEN", "")
+'''
+
+ENV_PRAGMA = '''
+import os
+token = os.environ.get("UNDECLARED_TOKEN", "")  # lint: disable=env-contract
+'''
+
+
+@pytest.mark.deploylint
+def test_env_contract_flags_undeclared_read_and_passes_declared_twin():
+    checker = EnvContractChecker()
+    checker.declared_override = {}
+    findings = run_on_source(ENV_BAD, [checker])
+    assert checks_of(findings) == {"env-contract"}
+    assert "UNDECLARED_TOKEN" in findings[0].message
+
+    declared = EnvContractChecker()
+    declared.declared_override = {
+        "UNDECLARED_TOKEN": EnvKnob("UNDECLARED_TOKEN", "", "fixture", "doc")
+    }
+    assert run_on_source(ENV_BAD, [declared]) == []
+
+
+@pytest.mark.deploylint
+def test_env_contract_pragma_suppresses_like_every_checker():
+    checker = EnvContractChecker()
+    checker.declared_override = {}
+    assert run_on_source(ENV_PRAGMA, [checker]) == []
+
+
+@pytest.mark.deploylint
+def test_env_contract_flags_dead_knob_and_manifest_drift():
+    checker = EnvContractChecker()
+    checker.declared_override = {
+        "GHOST_KNOB": EnvKnob("GHOST_KNOB", "", "nobody", "doc"),
+        "SHIPPED_KNOB": EnvKnob(
+            "SHIPPED_KNOB", "", "nobody", "doc", manifest=True
+        ),
+    }
+    checker.manifest_names_override = {"ORPHAN_ENV"}
+    checker.force_finish = True
+    messages = [f.message for f in run_on_source("x = 1", [checker])]
+    assert any("dead knob" in m and "GHOST_KNOB" in m for m in messages)
+    assert any("manifest=True" in m and "SHIPPED_KNOB" in m for m in messages)
+    assert any("ORPHAN_ENV" in m and "does not declare" in m for m in messages)
+
+
+FLOW_BAD = '''
+def serve(client):
+    with flow_context("totally-unknown-flow"):
+        client.list(Notebook)
+'''
+
+FLOW_CLEAN = '''
+def serve(client):
+    with flow_context("notebook"):
+        client.list(Notebook)
+'''
+
+
+@pytest.mark.deploylint
+def test_flow_schema_coverage_flags_default_classification():
+    findings = run_on_source(FLOW_BAD, [FlowSchemaCoverageChecker()])
+    assert checks_of(findings) == {"flow-schema-coverage"}
+    assert "default PriorityLevel" in findings[0].message
+    assert run_on_source(FLOW_CLEAN, [FlowSchemaCoverageChecker()]) == []
+
+
+@pytest.mark.deploylint
+def test_flow_schema_coverage_flags_declared_flow_nothing_enters():
+    from odh_kubeflow_tpu.analysis.framework import ModuleInfo
+
+    decl = 'SCHEMAS = (FlowSchema("fixture", "system", flows=("ghost-flow",)),)'
+    checker = FlowSchemaCoverageChecker()
+    m = ModuleInfo.parse(
+        "odh_kubeflow_tpu/cluster/flowcontrol.py", source=decl
+    )
+    assert list(checker.check(m)) == []
+    findings = list(checker.finish())
+    assert findings and "ghost-flow" in findings[0].message
+
+    # the twin: a second module entering the flow clears the finding
+    entered = FlowSchemaCoverageChecker()
+    assert list(entered.check(ModuleInfo.parse(
+        "odh_kubeflow_tpu/cluster/flowcontrol.py",
+        source='S = (FlowSchema("fixture", "system", flows=("notebook",)),)',
+    ))) == []
+    assert list(entered.check(ModuleInfo.parse(MANAGER_PATH, source=FLOW_CLEAN))) == []
+    assert list(entered.finish()) == []
+
+
+@pytest.mark.deploylint
+def test_flow_schema_coverage_checks_webhook_paths_both_ways():
+    served_unregistered = FlowSchemaCoverageChecker()
+    served_unregistered.webhook_paths_override = {"/mutate-notebook-v1"}
+    findings = run_on_source(
+        'server.register("/mutate-bogus-v1", handler)\n',
+        [served_unregistered],
+    )
+    assert findings and "never call it" in findings[0].message
+
+    declared_unserved = FlowSchemaCoverageChecker()
+    declared_unserved.webhook_paths_override = {"/mutate-notebook-v1"}
+    findings = run_on_source(
+        "x = 1", [declared_unserved], path="odh_kubeflow_tpu/main.py"
+    )
+    assert findings and "fail closed" in findings[0].message
+
+    clean = FlowSchemaCoverageChecker()
+    clean.webhook_paths_override = {"/mutate-notebook-v1"}
+    assert run_on_source(
+        'server.register("/mutate-notebook-v1", handler)\n',
+        [clean],
+        path="odh_kubeflow_tpu/main.py",
+    ) == []
+
+
+@pytest.mark.deploylint
+def test_deploylint_family_is_clean_on_the_real_package():
+    """The ci/analysis.sh --deploy acceptance bar, as a pytest gate."""
+    import pathlib
+
+    import odh_kubeflow_tpu
+
+    pkg = pathlib.Path(odh_kubeflow_tpu.__file__).parent
+    findings = run_analysis([str(pkg)], checkers=make_deploylint_checkers())
+    assert findings == [], "\n".join(str(f) for f in findings)
 
 
 # ---------------------------------------------------------------------------

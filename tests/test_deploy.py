@@ -139,6 +139,44 @@ def test_committed_deploy_tree_is_not_drifted(tmp_path):
         assert got == want, f"deploy/{rel} drifted — run python -m odh_kubeflow_tpu.deploy generate"
 
 
+@pytest.mark.deploylint
+def test_build_manifests_check_mode_catches_unregenerated_edit(tmp_path):
+    """ci/build_manifests.sh --check (ISSUE 14): clean on the committed
+    tree, and a hand-edit to the committed YAML without regenerating fails
+    the gate — non-mutating, so the working tree is untouched either way."""
+    import shutil
+
+    subprocess.run(
+        ["bash", os.path.join("ci", "build_manifests.sh"), "--check"],
+        cwd=REPO,
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+
+    # sandbox repo: the real script + package against a doctored deploy/
+    sandbox = tmp_path / "repo"
+    (sandbox / "ci").mkdir(parents=True)
+    shutil.copy(
+        os.path.join(REPO, "ci", "build_manifests.sh"), sandbox / "ci"
+    )
+    shutil.copytree(os.path.join(REPO, "deploy"), sandbox / "deploy")
+    base = sandbox / "deploy" / "base" / "manifests.yaml"
+    base.write_text(base.read_text().replace("replicas: 1", "replicas: 3", 1))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        ["bash", "ci/build_manifests.sh", "--check"],
+        cwd=sandbox,
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "drifted" in out.stderr
+    # ...and the doctored tree was not silently rewritten by the check
+    assert "replicas: 3" in base.read_text()
+
+
 def test_cli_build_prints_yaml():
     out = subprocess.run(
         [sys.executable, "-m", "odh_kubeflow_tpu.deploy", "build", "standalone"],

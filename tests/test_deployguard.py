@@ -1,0 +1,245 @@
+"""DEPLOYGUARD runtime-twin contract tests (ISSUE 14).
+
+The static deploylint pass proves the SOURCE stays inside the declared
+deployment surface; these tests prove the PROCESS guard catches the same
+drift live — and that it costs nothing when disarmed:
+
+- a manager-flow request exceeding the declared RBAC raises RBACDriftError
+  AT the offending call, naming the flow, verb and kind;
+- traffic inside the declared surface passes and is recorded;
+- the two flow-identity invariants hold: the leader-election flow carries
+  only Lease traffic, and Lease traffic never rides a controller flow (the
+  misattributed-lease-write regression — exactly the failover drift the
+  armed loadtest lanes turn into a hard failure);
+- non-manager flows (sim actors, drivers, bare test clients) are
+  record-only, never enforced;
+- the surface artifact round-trips: dump -> JSON -> the rbac-coverage
+  checker's --deploy-surface input, merging across processes;
+- the per-call audit stays under 10% overhead armed and the whole module
+  is inert with DEPLOYGUARD unset (same bar as the invcheck/jaxguard
+  overhead tests).
+"""
+import json
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.analysis import deploysurface as ds
+from odh_kubeflow_tpu.analysis.checkers.deploylint import RbacCoverageChecker
+from odh_kubeflow_tpu.api.coordination import Lease
+from odh_kubeflow_tpu.api.core import ConfigMap, Namespace
+from odh_kubeflow_tpu.cluster import Client, Store
+from odh_kubeflow_tpu.cluster.flowcontrol import (
+    LEADER_ELECTION_FLOW,
+    flow_context,
+)
+from odh_kubeflow_tpu.utils import deployguard
+
+pytestmark = [pytest.mark.analysis, pytest.mark.deploylint]
+
+NS = "deployguard"
+
+
+@pytest.fixture
+def armed():
+    deployguard.disarm()
+    guard = deployguard.arm()
+    yield guard
+    deployguard.disarm()
+
+
+def mk_cm(name: str) -> ConfigMap:
+    cm = ConfigMap()
+    cm.metadata.namespace = NS
+    cm.metadata.name = name
+    return cm
+
+
+def mk_lease(name: str) -> Lease:
+    lease = Lease()
+    lease.metadata.namespace = "kube-system"
+    lease.metadata.name = name
+    return lease
+
+
+# ---------------------------------------------------------------------------
+# enforcement on manager flows
+# ---------------------------------------------------------------------------
+
+def test_granted_surface_passes_and_is_recorded(armed):
+    client = Client(Store())
+    with flow_context("notebook"):
+        client.create(mk_cm("green"))
+        client.get(ConfigMap, NS, "green")
+        client.list(ConfigMap, namespace=NS)
+    assert ("notebook", "create", "ConfigMap", "") in armed.surface
+    assert ("notebook", "get", "ConfigMap", "") in armed.surface
+    assert ("notebook", "list", "ConfigMap", "") in armed.surface
+    assert armed.drifts == 0
+
+
+def test_ungranted_verb_raises_at_the_offending_call(armed):
+    client = Client(Store())
+    with flow_context("notebook"):
+        with pytest.raises(deployguard.RBACDriftError) as ei:
+            client.create(_ns("drift"))
+    msg = str(ei.value)
+    # the error names flow, verb and kind — enough to find the call without
+    # a debugger
+    assert "notebook" in msg and "create" in msg and "Namespace" in msg
+    assert armed.drifts == 1
+    # the attempt is still part of the recorded surface (the artifact must
+    # show what the process TRIED, drift included)
+    assert ("notebook", "create", "Namespace", "") in armed.surface
+
+
+def _ns(name: str) -> Namespace:
+    ns = Namespace()
+    ns.metadata.name = name
+    return ns
+
+
+def test_non_manager_flows_are_record_only(armed):
+    """Sim actors and bare test clients carry their own identities — their
+    traffic never counts against the manager's RBAC."""
+    client = Client(Store())
+    # no flow_context at all: the anonymous default flow
+    client.create(_ns("anonymous"))
+    with flow_context("kubelet"):
+        client.create(_ns("sim-actor"))
+    assert armed.drifts == 0
+    assert ("", "create", "Namespace", "") in armed.surface
+    assert ("kubelet", "create", "Namespace", "") in armed.surface
+
+
+# ---------------------------------------------------------------------------
+# flow-identity invariants (the misattributed-lease regression)
+# ---------------------------------------------------------------------------
+
+def test_lease_write_on_controller_flow_is_a_hard_failure(armed):
+    """The shard-failover drift the armed loadtest lanes exist to catch: a
+    lease write attributed to a workload flow would contend in the workload
+    budget and dodge the write fence. DEPLOYGUARD fails it at the call."""
+    client = Client(Store())
+    with flow_context("notebook"):
+        with pytest.raises(deployguard.RBACDriftError, match="Lease"):
+            client.create(mk_lease("misattributed"))
+    assert armed.drifts == 1
+
+
+def test_elector_client_lease_traffic_passes(armed):
+    """The legitimate path: the elector's own client pins the exempt flow."""
+    elector_client = Client(Store())
+    elector_client.flow = LEADER_ELECTION_FLOW
+    lease = elector_client.create(mk_lease("held"))
+    lease.spec.holder_identity = "mgr-0"
+    elector_client.update(lease)
+    assert armed.drifts == 0
+    assert (LEADER_ELECTION_FLOW, "create", "Lease", "") in armed.surface
+
+
+def test_leader_election_flow_may_only_carry_lease_traffic(armed):
+    elector_client = Client(Store())
+    elector_client.flow = LEADER_ELECTION_FLOW
+    with pytest.raises(deployguard.RBACDriftError, match="only"):
+        elector_client.create(mk_cm("smuggled"))
+    assert armed.drifts == 1
+
+
+# ---------------------------------------------------------------------------
+# disarmed: inert
+# ---------------------------------------------------------------------------
+
+def test_disarmed_client_is_inert(monkeypatch):
+    monkeypatch.delenv("DEPLOYGUARD", raising=False)
+    deployguard.disarm()
+    assert deployguard.ACTIVE is None
+    client = Client(Store())
+    with flow_context("notebook"):
+        client.create(_ns("off"))  # would drift armed; passes disarmed
+    assert deployguard.ACTIVE is None
+
+
+def test_enabled_parses_like_the_sibling_guards(monkeypatch):
+    for value, want in (("", False), ("0", False), ("false", False),
+                        ("1", True), ("true", True)):
+        monkeypatch.setenv("DEPLOYGUARD", value)
+        assert deployguard.enabled() is want
+    monkeypatch.delenv("DEPLOYGUARD")
+    assert deployguard.enabled() is False
+
+
+# ---------------------------------------------------------------------------
+# the surface artifact
+# ---------------------------------------------------------------------------
+
+def test_surface_artifact_round_trips_into_the_checker(armed, tmp_path):
+    client = Client(Store())
+    with flow_context("notebook"):
+        client.create(mk_cm("dumped"))
+    out = tmp_path / "surface.json"
+    armed.dump(str(out))
+    surface = ds.surface_tuples_from_artifact(json.loads(out.read_text()))
+    assert ("notebook", "create", "ConfigMap", "") in surface
+    assert ("", "configmaps") in ds.exercised_resources_from_surface(surface)
+    # the checker consumes exactly this shape (cli.py --deploy-surface)
+    checker = RbacCoverageChecker()
+    checker.surface = surface
+    assert checker.surface
+
+
+def test_surface_dump_merges_across_processes(armed, tmp_path):
+    """faults.sh lanes run several pytest processes against one artifact
+    path — a later dump must union with, not clobber, the earlier one."""
+    out = tmp_path / "surface.json"
+    armed.surface.add(("notebook", "get", "ConfigMap", ""))
+    armed.dump(str(out))
+    second = deployguard.Guard()
+    second.surface.add(("tpu-job", "update_status", "TPUJob", "status"))
+    second.dump(str(out))
+    merged = ds.surface_tuples_from_artifact(json.loads(out.read_text()))
+    assert ("notebook", "get", "ConfigMap", "") in merged
+    assert ("tpu-job", "update_status", "TPUJob", "status") in merged
+
+
+def test_update_status_maps_to_the_status_subresource(armed):
+    client = Client(Store())
+    with flow_context("notebook"):
+        cm = client.create(mk_cm("sub"))
+        client.update(cm)
+    assert ("notebook", "update", "ConfigMap", "") in armed.surface
+    # the mapping table, not the client, owns the subresource attribution
+    assert ds.CLIENT_VERBS["update_status"] == ("update", "status")
+    assert ds.required_rbac("update_status", "Notebook") == (
+        "kubeflow.org", "notebooks/status", "update",
+    )
+
+
+# ---------------------------------------------------------------------------
+# overhead
+# ---------------------------------------------------------------------------
+
+def test_armed_observe_overhead_under_ten_percent(armed):
+    store = Store()
+    client = Client(store)
+    with flow_context("notebook"):
+        client.create(mk_cm("bench"))
+    n = 200
+
+    def run():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            client.get(ConfigMap, NS, "bench")
+        return (time.perf_counter() - t0) / n
+
+    with flow_context("notebook"):
+        armed_cost = min(run() for _ in range(3))
+    deployguard.disarm()
+    with flow_context("notebook"):
+        base = min(run() for _ in range(3))
+    added = armed_cost - base
+    # same bar as the invcheck/jaxguard overhead tests: 10% or an absolute
+    # floor that absorbs scheduler noise on a loaded CI box
+    assert added < max(0.10 * base, 0.0005), (
+        f"observe adds {added * 1e6:.1f}us/call over {base * 1e6:.1f}us"
+    )

@@ -13,6 +13,7 @@ import time
 
 import pytest
 
+from odh_kubeflow_tpu.api.coordination import Lease
 from odh_kubeflow_tpu.api.core import ConfigMap
 from odh_kubeflow_tpu.apimachinery import TooManyRequestsError
 from odh_kubeflow_tpu.cluster import Client, Store
@@ -240,14 +241,19 @@ def test_client_gates_through_store_flowcontrol():
 
 def test_client_flow_override_rides_exempt_level():
     """The elector's client sets flow='leader-election': its writes bypass a
-    saturated level entirely (failover never queues behind the storm)."""
+    saturated level entirely (failover never queues behind the storm). The
+    traffic is a real Lease — DEPLOYGUARD holds the elector identity to
+    Lease-only, so a stand-in kind would (correctly) fail armed."""
     store = Store()
     store.flowcontrol = tiny_controller(seats=1, queue_length=0, timeout=0.05)
     hog = store.flowcontrol.admit("hog")
     try:
         elector_client = Client(store)
         elector_client.flow = LEADER_ELECTION_FLOW
-        elector_client.create(mk_cm("lease-ish"))  # admitted despite saturation
+        lease = Lease()
+        lease.metadata.namespace = "flows"
+        lease.metadata.name = "mgr"
+        elector_client.create(lease)  # admitted despite saturation
         s = store.flowcontrol.summary()
         assert s["exempt"]["rejected"] == 0 and s["exempt"]["dispatched"] >= 1
     finally:
