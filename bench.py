@@ -40,8 +40,15 @@ gets without the pallas kernel) is reported separately and explicitly as
 from __future__ import annotations
 
 import json
+import os as _os
 import statistics
 import time
+
+# pkgutil-style package root: the driver runs this file as a SCRIPT
+# (`python bench.py`), so `bench/` can't be a regular package without
+# shadowing it — setting __path__ makes `import bench.ledger` resolve
+# bench/ledger.py as a submodule of this module (ISSUE 15 ledger)
+__path__ = [_os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "bench")]
 
 V5E_PEAK_FLOPS = 197e12  # bf16 peak, TPU v5e chip
 V5E_HBM_GBPS = 819  # HBM bandwidth, TPU v5e chip (GB/s)
@@ -257,23 +264,33 @@ def bench_train_step():
     compile_base = jaxguard.compile_count("bench.train_step")
     step = jaxguard.jit(step, region="bench.train_step", donate_argnums=(0, 1))
 
-    # warm (compile)
-    params, opt_state, loss = step(params, opt_state, batch_d)
-    float(loss)
+    from odh_kubeflow_tpu.utils import profiler
 
-    # two-length slope (see module docstring): steps chain through
-    # params/opt_state on device; the tunnel round-trip cancels
-    def run_n(n):
-        nonlocal params, opt_state, loss
-        t0 = time.perf_counter()
-        for _ in range(n):
+    # PROFILE=1 (ISSUE 15): the whole measurement is one bench.train_step
+    # region decomposed into warm_compile -> slope_short -> slope_long
+    # phases — the report's where_time_went shows whether a slow bench run
+    # spent its time compiling or stepping
+    with profiler.region("bench.train_step", consumer="bench"):
+        with profiler.phase("warm_compile"):
             params, opt_state, loss = step(params, opt_state, batch_d)
-        float(loss)  # host fetch = true completion
-        return time.perf_counter() - t0
+            float(loss)
 
-    run_n(1)
-    t_short = min(run_n(2) for _ in range(2))
-    t_long = min(run_n(14) for _ in range(2))
+        # two-length slope (see module docstring): steps chain through
+        # params/opt_state on device; the tunnel round-trip cancels
+        def run_n(n):
+            nonlocal params, opt_state, loss
+            t0 = time.perf_counter()
+            for _ in range(n):
+                params, opt_state, loss = step(params, opt_state, batch_d)
+            float(loss)  # host fetch = true completion
+            return time.perf_counter() - t0
+
+        with profiler.phase("warm_steady"):
+            run_n(1)
+        with profiler.phase("slope_short"):
+            t_short = min(run_n(2) for _ in range(2))
+        with profiler.phase("slope_long"):
+            t_long = min(run_n(14) for _ in range(2))
     step_s = (t_long - t_short) / 12
 
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
@@ -657,6 +674,9 @@ def bench_serving():
     from odh_kubeflow_tpu.analysis import hotregions
     from odh_kubeflow_tpu.utils import jaxguard
 
+    from odh_kubeflow_tpu.tpu import telemetry as _telemetry
+    from odh_kubeflow_tpu.utils import profiler
+
     jaxguard_prev = os.environ.get("JAXGUARD")
     os.environ["JAXGUARD"] = "1"
     try:
@@ -673,12 +693,21 @@ def bench_serving():
         t0 = time.perf_counter()
         for i, n in enumerate(order):
             handles.append(engine.submit(list(prompts[i]), max_new=n))
+        steps_since_mem = 0
         while not engine.idle():
             s0 = time.perf_counter()
             active = engine.stats()["active_slots"]
             engine.step()
             if active:
                 step_samples.append((time.perf_counter() - s0, active))
+            # feed the profiler's HBM watermark every few bursts (the live
+            # probe agent does this from its own thread; the bench samples
+            # inline so the serving section can report hbm_headroom)
+            steps_since_mem += 1
+            if steps_since_mem >= 8:
+                steps_since_mem = 0
+                _telemetry.update_device_memory()
+        _telemetry.update_device_memory()
         cb_s = time.perf_counter() - t0
         cb_goodput = sum(len(h.tokens) for h in handles) / cb_s
     finally:
@@ -740,6 +769,10 @@ def bench_serving():
         # burst — at decode_burst=16 that's 5 tunnel round trips amortized
         # to 1 per 16 tokens/slot)
         "drain_note": "post-burst drain batched: 1 host sync per burst (was 5)",
+        # ISSUE 15: global HBM watermark + headroom mined from the
+        # profiler's device-memory feed (null on a backend without
+        # memory_stats, e.g. the CPU proxy)
+        "hbm_headroom": profiler.hbm_stats(),
     }
 
 
@@ -1516,6 +1549,18 @@ def bench_control_plane():
     }
 
 
+def _stamp_ledger(result):
+    """Attach the trajectory ledger + where_time_went to the report (ISSUE
+    15). Never costs the artifact: any ledger failure lands as an error
+    field inside the block, and an unimportable ledger is skipped."""
+    try:
+        from bench import ledger
+    except Exception as e:  # pragma: no cover - packaging diagnostics
+        result["ledger"] = {"error": f"unimportable: {e!r}"[:300]}
+        return result
+    return ledger.stamp(result)
+
+
 def main() -> None:
     # Positive-evidence accelerator detection (VERDICT r3 weak #1): round 3's
     # `jax.default_backend() == "tpu"` gate silently skipped every TPU
@@ -1527,6 +1572,12 @@ def main() -> None:
     # control-plane numbers are out.
     import os
     import threading
+
+    # arm the continuous profiler for the whole run (ISSUE 15): every
+    # guarded region/jit and every engine step feeds the where_time_went
+    # breakdown the ledger stamps into the report. Respect an explicit
+    # PROFILE=0 (overhead A/B runs).
+    os.environ.setdefault("PROFILE", "1")
 
     detail = {"tpu_present": False}
 
@@ -1585,13 +1636,13 @@ def main() -> None:
             "partial results emitted"
         )
         cp = detail.get("control_plane", {})
-        print(json.dumps({
+        print(json.dumps(_stamp_ledger({
             "metric": "notebook_cr_to_slice_ready_p50",
             "value": cp.get("cr_to_mesh_ready_p50_s"),
             "unit": "s",
             "vs_baseline": 1.0,
             "detail": detail,
-        }), flush=True)
+        })), flush=True)
         os._exit(0)
 
     kernels = train = None
@@ -1652,7 +1703,7 @@ def main() -> None:
             "vs_baseline": 1.0,  # no comparable published number exists
             "detail": detail,
         }
-    print(json.dumps(result))
+    print(json.dumps(_stamp_ledger(result)))
 
 
 if __name__ == "__main__":

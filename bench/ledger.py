@@ -1,0 +1,483 @@
+"""Bench trajectory ledger: declared headline metrics, vs-prior deltas, and
+the regression gate (ISSUE 15).
+
+Five rounds of BENCH_rNN.json sat uncompared by any machinery — ROADMAP
+item 3 demands "every claim lands in bench.py with a vs-prior-round delta",
+and this module is that layer:
+
+- **HEADLINES** is the single source of truth for what the bench is judged
+  on: each entry declares the metric's name, the json path into the bench
+  report where it lives, which direction is better, and the fractional
+  regression tolerance the gate enforces. `check_headlines()` validates the
+  registry slo-lint style (unique names, known directions, sane tolerances)
+  and is wired into `ci/bench_gate.sh`.
+- **load_trajectory()** parses the committed BENCH_rNN.json files. Rounds
+  are driver wrappers ({n, cmd, rc, tail, parsed}); a wrapper whose
+  `parsed` is null (r05's truncated tail) falls back to the raw
+  BENCH_rNN_insession.json report when one is committed.
+- **stamp()** is called by bench.main() on every report: it attaches a
+  `ledger` block with a `vs_prior` delta for EVERY declared headline
+  (computed against the last committed round that carried the metric) and a
+  `where_time_went` per-phase breakdown mined from the PROFILE=1 profiler —
+  the data-plane twin of the control plane's `readiness_phases`.
+- **gate()** is the CI lane: registry lint, then the committed trajectory's
+  latest round judged against its prior (a committed regression past
+  tolerance fails the tree), then optionally a fresh report file judged the
+  same way. `quick_proxy()` runs a tiny CPU serving episode under
+  PROFILE=1 + JAXGUARD=1 and enforces the machine-independent invariants
+  (one batched drain per burst, compile budget held, phase coverage >= 0.9)
+  — the subset of the bench contract a CPU lane can honestly gate.
+
+Tolerances are declared per headline because the headlines have different
+noise floors: kernel/train numbers are slope-measured (tunnel jitter
+cancels) and hold ~10%; the control-plane p50 is an in-process sim number
+dominated by host scheduling noise (r04 -> r05 moved +52% with zero
+control-plane changes), so its tolerance is wide and documented as such.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SCHEMA = "bench-ledger/v1"
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# the declared headline registry — ONE source of truth
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Headline:
+    name: str
+    path: Tuple[str, ...]  # json path into a bench report
+    direction: str  # "higher" | "lower" is better
+    tolerance: float  # fractional regression allowed before the gate fails
+    note: str = ""
+
+
+HEADLINES: Tuple[Headline, ...] = (
+    Headline(
+        name="train_step_tokens_per_s_v5e1",
+        path=("detail", "train_step", "tokens_per_s"),
+        direction="higher",
+        tolerance=0.10,
+        note="flagship train step, two-length slope (tunnel cancels)",
+    ),
+    Headline(
+        name="train_step_mfu",
+        path=("detail", "train_step", "mfu_est"),
+        direction="higher",
+        tolerance=0.10,
+        note="estimated model-FLOPs utilization of the train step",
+    ),
+    Headline(
+        name="kernel_mfu",
+        path=("detail", "kernels", "kernel_mfu"),
+        direction="higher",
+        tolerance=0.10,
+        note="VERDICT-r1 acceptance number (flash kernel at 4k)",
+    ),
+    Headline(
+        name="decode_tokens_per_s",
+        path=("detail", "decode", "decode_only_tokens_per_s"),
+        direction="higher",
+        tolerance=0.15,
+        note="single-slot autoregressive decode throughput",
+    ),
+    Headline(
+        name="serving_goodput_vs_static_batch",
+        path=("detail", "serving", "goodput_vs_static_batch"),
+        direction="higher",
+        tolerance=0.15,
+        note="continuous batching vs static at equal slots (>= 1.5x "
+             "acceptance); no committed round carries it yet, so vs_prior "
+             "is null until the first TPU run after ISSUE 9 lands one",
+    ),
+    Headline(
+        name="cr_to_mesh_ready_p50_s",
+        path=("detail", "control_plane", "cr_to_mesh_ready_p50_s"),
+        direction="lower",
+        tolerance=0.75,
+        note="in-process sim latency dominated by host scheduling noise "
+             "(r04 -> r05 moved +52% with zero control-plane changes); "
+             "wide tolerance catches order-of-magnitude breaks only",
+    ),
+)
+
+
+def check_headlines(
+    headlines: Sequence[Headline] = HEADLINES,
+) -> List[str]:
+    """Registry validation, slo-lint style: a list of human-readable
+    problems, empty when the registry is well-formed."""
+    problems: List[str] = []
+    seen: set = set()
+    for h in headlines:
+        where = f"headline {h.name!r}"
+        if not h.name or not re.fullmatch(r"[a-z][a-z0-9_]*", h.name):
+            problems.append(f"{where}: name must be snake_case")
+        if h.name in seen:
+            problems.append(f"{where}: duplicate name")
+        seen.add(h.name)
+        if h.direction not in ("higher", "lower"):
+            problems.append(
+                f"{where}: direction must be 'higher' or 'lower', "
+                f"got {h.direction!r}"
+            )
+        if not h.path or not all(
+            isinstance(p, str) and p for p in h.path
+        ):
+            problems.append(f"{where}: path must be non-empty str segments")
+        if not (0.0 < h.tolerance < 1.0):
+            problems.append(
+                f"{where}: tolerance must be a fraction in (0, 1), "
+                f"got {h.tolerance}"
+            )
+        if h.tolerance > 0.25 and not h.note:
+            problems.append(
+                f"{where}: a tolerance this wide ({h.tolerance}) must carry "
+                f"a note documenting why"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# trajectory loading
+# ---------------------------------------------------------------------------
+
+
+def _extract(report: Optional[Dict[str, Any]],
+             path: Tuple[str, ...]) -> Optional[float]:
+    node: Any = report
+    for seg in path:
+        if not isinstance(node, dict) or seg not in node:
+            return None
+        node = node[seg]
+    return node if isinstance(node, (int, float)) else None
+
+
+def load_trajectory(
+    root: Optional[str] = None,
+) -> List[Tuple[int, Dict[str, Any]]]:
+    """The committed BENCH_rNN.json rounds as [(round, report)], ascending.
+    Driver wrappers contribute their `parsed` report; a null `parsed` falls
+    back to the round's raw _insession report when committed (r05). Rounds
+    with no recoverable report are skipped. `root` (or $BENCH_LEDGER_DIR)
+    overrides the repo root — the doctored-regression tests use this."""
+    root = root or os.environ.get("BENCH_LEDGER_DIR") or _ROOT
+    rounds: Dict[int, Dict[str, Any]] = {}
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return []
+    for fname in names:
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", fname)
+        if not m:
+            continue
+        n = int(m.group(1))
+        try:
+            with open(os.path.join(root, fname)) as f:
+                obj = json.load(f)
+        except (OSError, ValueError):
+            continue
+        report = obj.get("parsed") if "parsed" in obj else obj
+        if report is None:
+            fallback = os.path.join(root, f"BENCH_r{n:02d}_insession.json")
+            try:
+                with open(fallback) as f:
+                    report = json.load(f)
+            except (OSError, ValueError):
+                report = None
+        if isinstance(report, dict):
+            rounds[n] = report
+    return sorted(rounds.items())
+
+
+# ---------------------------------------------------------------------------
+# vs_prior + where_time_went
+# ---------------------------------------------------------------------------
+
+
+def _judge(h: Headline, value: Optional[float],
+           prior: Optional[float]) -> Dict[str, Any]:
+    entry: Dict[str, Any] = {
+        "value": value,
+        "prior": prior,
+        "direction": h.direction,
+        "tolerance": h.tolerance,
+        "delta_frac": None,
+        "regressed": False,
+    }
+    if value is None or prior is None or prior == 0:
+        return entry
+    delta = (value - prior) / abs(prior)
+    entry["delta_frac"] = round(delta, 4)
+    if h.direction == "higher":
+        entry["regressed"] = delta < -h.tolerance
+    else:
+        entry["regressed"] = delta > h.tolerance
+    return entry
+
+
+def vs_prior(
+    report: Dict[str, Any],
+    trajectory: Optional[List[Tuple[int, Dict[str, Any]]]] = None,
+    root: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The `ledger` block for one bench report: every declared headline with
+    its value, the last committed round that carried the metric, and the
+    tolerance-judged delta. Headlines the report (or the whole trajectory)
+    doesn't carry get null values — absence is visible, never silent."""
+    if trajectory is None:
+        trajectory = load_trajectory(root)
+    headlines: Dict[str, Any] = {}
+    for h in HEADLINES:
+        value = _extract(report, h.path)
+        prior = prior_round = None
+        for n, past in reversed(trajectory):
+            if past is report:
+                continue
+            v = _extract(past, h.path)
+            if v is not None:
+                prior, prior_round = v, n
+                break
+        entry = _judge(h, value, prior)
+        entry["prior_round"] = prior_round
+        headlines[h.name] = entry
+    return {
+        "schema": SCHEMA,
+        "trajectory_rounds": [n for n, _ in trajectory],
+        "headlines": headlines,
+    }
+
+
+def where_time_went(
+    snapshot: Optional[Dict[str, Any]] = None,
+    regions: Sequence[str] = ("serving.decode_burst", "bench.train_step"),
+) -> Dict[str, Any]:
+    """Per-phase breakdown for the data-plane hot regions, mined from the
+    PROFILE=1 profiler — the data-plane twin of `readiness_phases`. Phase
+    SELF times partition the region total (profiler accounting invariant),
+    so `coverage` — their sum over the region total — lands >= 0.9 on a
+    healthy run; a low coverage means untracked time inside the region."""
+    if snapshot is None:
+        from odh_kubeflow_tpu.utils import profiler
+
+        snapshot = profiler.snapshot()
+    out: Dict[str, Any] = {}
+    for name in regions:
+        s = (snapshot.get("regions") or {}).get(name)
+        if not s or not s.get("phases"):
+            continue
+        total = s.get("total_s") or 0.0
+        phases = {}
+        covered = 0.0
+        for pname, ps in s["phases"].items():
+            covered += ps["self_s"]
+            phases[pname] = {
+                "self_s": round(ps["self_s"], 6),
+                "frac": round(ps["self_s"] / total, 4) if total else None,
+            }
+        out[name] = {
+            "count": s["count"],
+            "total_s": round(total, 6),
+            "coverage": round(covered / total, 4) if total else None,
+            "phases": phases,
+        }
+    return out
+
+
+def stamp(
+    result: Dict[str, Any],
+    root: Optional[str] = None,
+    snapshot: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Attach the ledger block (+ where_time_went under detail) to a bench
+    report in place. bench.main() calls this on every emitted report; never
+    raises — a ledger failure must not cost the bench artifact."""
+    try:
+        result["ledger"] = vs_prior(result, root=root)
+        wtw = where_time_went(snapshot)
+        if wtw:
+            result.setdefault("detail", {})["where_time_went"] = wtw
+    except Exception as e:  # pragma: no cover - defensive
+        result["ledger"] = {"schema": SCHEMA, "error": repr(e)[:300]}
+    return result
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+
+def gate_trajectory(
+    trajectory: Optional[List[Tuple[int, Dict[str, Any]]]] = None,
+    root: Optional[str] = None,
+) -> List[str]:
+    """Judge the trajectory's LATEST round against its prior rounds: a list
+    of failures (empty = green). A committed round that regressed a declared
+    headline past tolerance fails the tree — the gate the next perf PR is
+    judged by."""
+    if trajectory is None:
+        trajectory = load_trajectory(root)
+    if len(trajectory) < 2:
+        return []  # nothing to compare yet — vacuously green
+    latest_n, latest = trajectory[-1]
+    block = vs_prior(latest, trajectory=trajectory[:-1])
+    failures = []
+    for name, entry in block["headlines"].items():
+        if entry["regressed"]:
+            failures.append(
+                f"headline {name!r}: r{latest_n:02d} value {entry['value']} "
+                f"regressed {entry['delta_frac']:+.1%} vs "
+                f"r{entry['prior_round']:02d} ({entry['prior']}), tolerance "
+                f"{entry['tolerance']:.0%} ({entry['direction']} is better)"
+            )
+    return failures
+
+
+def gate_report(path: str, root: Optional[str] = None) -> List[str]:
+    """Judge a fresh report file against the committed trajectory — the
+    lane a perf PR runs on its own bench output before committing it."""
+    with open(path) as f:
+        report = json.load(f)
+    block = vs_prior(report, root=root)
+    return [
+        f"headline {name!r}: value {e['value']} regressed "
+        f"{e['delta_frac']:+.1%} vs r{e['prior_round']:02d} ({e['prior']}), "
+        f"tolerance {e['tolerance']:.0%}"
+        for name, e in block["headlines"].items()
+        if e["regressed"]
+    ]
+
+
+def quick_proxy() -> Dict[str, Any]:
+    """The CPU-proxy subset: a tiny serving episode under PROFILE=1 +
+    JAXGUARD=1 enforcing the machine-independent bench invariants —
+    exactly one batched post-burst drain, compile budget held, and
+    where_time_went phase coverage >= 0.9 of the region total. Raises
+    AssertionError on violation; returns the mined breakdown."""
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import TransformerConfig, init_params
+    from odh_kubeflow_tpu.serving.engine import ServingEngine
+    from odh_kubeflow_tpu.utils import profiler
+
+    prev = {k: os.environ.get(k) for k in ("PROFILE", "JAXGUARD")}
+    os.environ["PROFILE"] = "1"
+    os.environ["JAXGUARD"] = "1"
+    try:
+        profiler.reset()
+        import jax
+
+        cfg = TransformerConfig(
+            vocab=128, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_seq=64, dtype=jnp.float32, use_flash=False,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        engine = ServingEngine(params, cfg, max_slots=2, max_seq=64,
+                               max_queue_depth=8, decode_burst=4)
+        for i, n in enumerate((6, 10, 4)):
+            engine.submit([1 + i, 2, 3, 4], max_new=n)
+        while not engine.idle():
+            engine.step()
+        stats = engine.stats()
+        assert stats["host_transfers_last_burst"] == 1, (
+            f"{stats['host_transfers_last_burst']} host transfers in the "
+            "last burst — steady state is ONE batched drain"
+        )
+        from odh_kubeflow_tpu.analysis import hotregions
+
+        budget = hotregions.get("serving.decode_burst").compile_budget
+        assert stats["decode_burst_recompiles"] <= budget, (
+            f"decode burst traced {stats['decode_burst_recompiles']}x, "
+            f"budget {budget}"
+        )
+        wtw = where_time_went(regions=("serving.decode_burst",))
+        assert "serving.decode_burst" in wtw, (
+            "profiler captured no serving.decode_burst region — the engine "
+            "step scope or the PROFILE arming is broken"
+        )
+        cov = wtw["serving.decode_burst"]["coverage"]
+        assert cov is not None and cov >= 0.9, (
+            f"phase coverage {cov} < 0.9 — phases no longer partition the "
+            "decode burst (untracked time inside the region)"
+        )
+        return wtw
+    finally:
+        profiler.reset()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="bench.ledger",
+        description="bench trajectory ledger: registry lint, regression "
+                    "gate, CPU-proxy invariants",
+    )
+    ap.add_argument("--lint", action="store_true",
+                    help="validate the headline registry")
+    ap.add_argument("--gate", action="store_true",
+                    help="judge the committed trajectory's latest round")
+    ap.add_argument("--report", metavar="FILE",
+                    help="judge a fresh report file against the trajectory")
+    ap.add_argument("--quick", action="store_true",
+                    help="run the CPU-proxy invariant subset")
+    args = ap.parse_args(argv)
+    rc = 0
+    ran = False
+    if args.lint:
+        ran = True
+        problems = check_headlines()
+        for p in problems:
+            print(f"ledger-lint: {p}")
+        print(f"ledger-lint: {len(HEADLINES)} headline(s), "
+              f"{len(problems)} problem(s)")
+        rc |= 1 if problems else 0
+    if args.gate:
+        ran = True
+        failures = gate_trajectory()
+        for f_ in failures:
+            print(f"bench-gate: {f_}")
+        traj = load_trajectory()
+        print(f"bench-gate: {len(traj)} round(s), "
+              f"{len(failures)} regression(s)")
+        rc |= 1 if failures else 0
+    if args.report:
+        ran = True
+        failures = gate_report(args.report)
+        for f_ in failures:
+            print(f"bench-gate[report]: {f_}")
+        print(f"bench-gate[report]: {len(failures)} regression(s)")
+        rc |= 1 if failures else 0
+    if args.quick:
+        ran = True
+        try:
+            wtw = quick_proxy()
+        except AssertionError as e:
+            print(f"bench-gate[quick]: FAIL: {e}")
+            rc |= 1
+        else:
+            cov = wtw["serving.decode_burst"]["coverage"]
+            print(f"bench-gate[quick]: ok (decode-burst phase coverage "
+                  f"{cov})")
+    if not ran:
+        ap.print_help()
+        return 2
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

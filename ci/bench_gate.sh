@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Bench trajectory regression gate (ISSUE 15): the bench/ledger.py lanes —
+#
+#   1. ledger lint: the declared headline registry (name, direction,
+#      tolerance — bench's single source of perf truth) is well-formed,
+#      slo-lint style.
+#   2. trajectory gate: the committed BENCH_rNN.json trajectory's latest
+#      round judged against its prior — a committed round that regressed a
+#      declared headline past its tolerance fails the tree.
+#   3. quick CPU proxy: a tiny serving episode under PROFILE=1 + JAXGUARD=1
+#      enforcing the machine-independent invariants (one batched drain per
+#      burst, compile budget held, where_time_went phase coverage >= 0.9).
+#      CPU wall-clock can't honestly judge TPU headlines, so the proxy
+#      gates structure, not speed.
+#
+# A fresh TPU bench report gates the same way before being committed:
+#   BENCH_REPORT=/path/to/report.json ./ci/bench_gate.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+rc=0
+echo "== bench gate: headline registry lint =="
+python -m bench.ledger --lint || rc=1
+
+echo "== bench gate: committed trajectory =="
+python -m bench.ledger --gate || rc=1
+
+if [ -n "${BENCH_REPORT:-}" ]; then
+    echo "== bench gate: fresh report ${BENCH_REPORT} =="
+    python -m bench.ledger --report "${BENCH_REPORT}" || rc=1
+fi
+
+echo "== bench gate: quick CPU-proxy invariants =="
+python -m bench.ledger --quick || rc=1
+
+if [ "$rc" -eq 0 ]; then
+    echo "== bench gate: green =="
+else
+    echo "== bench gate: FAILED =="
+fi
+exit $rc

@@ -116,6 +116,15 @@ echo "=== serving lane: DEPLOYGUARD=1 iteration ==="
 DEPLOYGUARD=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
+# ...and one with the continuous profiler armed (utils/profiler.py,
+# ISSUE 15): every decode burst decomposes into its admit/prefill/scan/
+# batched_drain/emit phases under fault churn — the soak proves the frame
+# accounting survives exception paths (a failed burst must not leak a
+# frame and skew every later where_time_went breakdown)
+echo "=== serving lane: PROFILE=1 iteration ==="
+PROFILE=1 python -m pytest tests/test_serving.py -q -m "serving and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
 # job lane (ISSUE 10): the gang-scheduled TPUJob machine under faults —
 # host preemption mid-Running (checkpoint-preempt-requeue, resume from the
 # acked step), the reclaimer taking a batch slice for an interactive

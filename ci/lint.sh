@@ -24,6 +24,12 @@ echo "== operator-lint (ci/analysis.sh) =="
 echo "== deploylint (ci/analysis.sh --deploy) =="
 ./ci/analysis.sh --deploy || rc=1
 
+# bench trajectory regression gate (ISSUE 15): headline-registry lint, the
+# committed BENCH_rNN.json trajectory judged against declared tolerances,
+# and the quick CPU-proxy invariant subset (ci/bench_gate.sh)
+echo "== bench gate (ci/bench_gate.sh) =="
+./ci/bench_gate.sh || rc=1
+
 if python -m ruff --version >/dev/null 2>&1; then
     echo "== ruff check =="
     python -m ruff check odh_kubeflow_tpu tests loadtest bench.py __graft_entry__.py || rc=1

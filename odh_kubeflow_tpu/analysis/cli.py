@@ -50,9 +50,13 @@ def _registry_lint() -> int:
     registry — the Python half metrics_lint.sh delegates to."""
     import odh_kubeflow_tpu.cluster.slicepool  # noqa: F401
     import odh_kubeflow_tpu.runtime.controller  # noqa: F401
+    import odh_kubeflow_tpu.runtime.jobmetrics  # noqa: F401  (TPUJob series)
     import odh_kubeflow_tpu.runtime.metrics as m
+    import odh_kubeflow_tpu.runtime.prober  # noqa: F401  (canary families)
     import odh_kubeflow_tpu.runtime.workqueue  # noqa: F401
+    import odh_kubeflow_tpu.serving.metrics  # noqa: F401  (inference families)
     import odh_kubeflow_tpu.tpu.telemetry  # noqa: F401
+    import odh_kubeflow_tpu.utils.profiler  # noqa: F401  (PROFILE=1 families)
     from odh_kubeflow_tpu.controllers.metrics import NotebookMetrics
 
     from .metric_rules import check_registry
@@ -83,6 +87,7 @@ def _slo_lint() -> int:
     import odh_kubeflow_tpu.runtime.metrics as m
     import odh_kubeflow_tpu.runtime.prober  # noqa: F401  (canary families)
     import odh_kubeflow_tpu.tpu.telemetry  # noqa: F401
+    import odh_kubeflow_tpu.utils.profiler  # noqa: F401  (PROFILE=1 families)
     from odh_kubeflow_tpu.controllers.metrics import NotebookMetrics
     from odh_kubeflow_tpu.runtime.alerts import default_rules
     from odh_kubeflow_tpu.runtime.slo import default_slos

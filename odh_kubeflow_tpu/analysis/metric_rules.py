@@ -14,6 +14,72 @@ from typing import List, Optional, Sequence
 METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
+# Documented observation range (lo_s, hi_s) per histogram family — the ONE
+# place bucket coverage is declared (ISSUE 15). The rule: the family's
+# first bucket must sit at or below lo, its last finite bucket at or above
+# hi, and at least 3 boundaries must land inside the range (resolution).
+# Seconds-scale defaults silently collapse ms-scale phase timings into one
+# bucket (the bug this lint exists for: tpu_decode_step_duration_seconds
+# shared the train-step buckets while a v5e decode step lands ~0.5-1ms).
+# Every registered histogram MUST appear here — an undeclared family is a
+# lint violation, so a new metric can't dodge the coverage question.
+HISTOGRAM_RANGES = {
+    "notebook_slice_ready_seconds": (0.1, 300.0),
+    "notebook_probe_sweep_seconds": (0.001, 10.0),
+    "notebook_resume_seconds": (0.05, 300.0),
+    "flowcontrol_wait_seconds": (0.001, 60.0),
+    "workqueue_queue_duration_seconds": (0.001, 60.0),
+    "controller_reconcile_duration_seconds": (0.001, 60.0),
+    "canary_probe_latency_seconds": (0.1, 300.0),
+    "tpu_job_queue_wait_seconds": (0.05, 1800.0),
+    "tpu_job_completion_seconds": (0.5, 7200.0),
+    "tpu_train_step_duration_seconds": (0.001, 30.0),
+    # a v5e decode step is sub-ms/token (BENCH_r05: 10k tok/s single-slot);
+    # the CPU sim stretches to seconds — the range spans both
+    "tpu_decode_step_duration_seconds": (0.0005, 30.0),
+    "tpu_slice_repair_duration_seconds": (0.1, 600.0),
+    "inference_ttft_seconds": (0.001, 10.0),
+    "inference_token_latency_seconds": (0.0005, 2.5),
+    "profile_phase_seconds": (0.0001, 2.5),
+    "profile_region_seconds": (0.0005, 30.0),
+    "profile_compile_seconds": (0.001, 60.0),
+}
+
+
+def check_histogram_buckets(name: str, buckets: Sequence[float]) -> List[str]:
+    """Bucket-coverage lint for one histogram family: its declared buckets
+    must bracket the documented observation range with usable resolution."""
+    rng = HISTOGRAM_RANGES.get(name)
+    if rng is None:
+        return [
+            f"{name}: histogram has no documented observation range — "
+            f"declare (lo_s, hi_s) in HISTOGRAM_RANGES (metric_rules.py) "
+            f"so bucket coverage is lintable"
+        ]
+    lo, hi = rng
+    violations: List[str] = []
+    finite = sorted(b for b in buckets if b != float("inf"))
+    if not finite:
+        return [f"{name}: histogram with no finite buckets"]
+    if finite[0] > lo:
+        violations.append(
+            f"{name}: first bucket {finite[0]}s is above the documented "
+            f"low end {lo}s — observations below it are indistinguishable"
+        )
+    if finite[-1] < hi:
+        violations.append(
+            f"{name}: last finite bucket {finite[-1]}s is below the "
+            f"documented high end {hi}s — the top of the range collapses "
+            f"into +Inf"
+        )
+    inside = [b for b in finite if lo <= b <= hi]
+    if len(inside) < 3:
+        violations.append(
+            f"{name}: only {len(inside)} bucket boundary(ies) inside the "
+            f"documented range [{lo}, {hi}]s — no usable resolution"
+        )
+    return violations
+
 
 def check_metric(
     name: str,
@@ -138,11 +204,17 @@ def check_registry(registry) -> List[str]:
     """Runtime lint of a live Registry: naming rules over every registered
     family, plus the exposition-completeness check (every family must appear
     in render() output — a family a scraper cannot see is a dead metric)."""
+    from odh_kubeflow_tpu.runtime.metrics import Histogram
+
     violations: List[str] = []
     for metric in registry._metrics.values():
         violations.extend(
             check_metric(metric.name, metric.type_name, metric.help, metric.label_names)
         )
+        if isinstance(metric, Histogram):
+            violations.extend(
+                check_histogram_buckets(metric.name, metric.buckets)
+            )
     text = registry.render()
     families = set()
     for line in text.splitlines():

@@ -442,4 +442,6 @@ ENV_CONTRACT: tuple = (
             "arm the deployment-surface runtime guard"),
     EnvKnob("DEPLOYGUARD_SURFACE_OUT", "", "utils/deployguard.py",
             "dump the recorded (flow, verb, kind) surface to this path"),
+    EnvKnob("PROFILE", "0", "utils/profiler.py",
+            "arm the continuous data-plane profiler"),
 )

@@ -156,6 +156,19 @@ class FlightRecorder:
         }
         if extra:
             bundle["extra"] = dict(extra)
+        # PROFILE=1 (ISSUE 15): freeze the continuous profiler's hot-region
+        # timings into the bundle — an incident during a decode-latency
+        # regression carries its own where-the-time-went evidence. Armed
+        # check first so the disarmed path stays import-only.
+        try:
+            from ..utils import profiler
+
+            if profiler.enabled():
+                prof = profiler.snapshot(limit=8)
+                if prof["regions"] or prof["spans"]:
+                    bundle["profile"] = prof
+        except Exception:  # pragma: no cover - never costs the bundle
+            pass
         with self._lock:
             self._incidents.append(bundle)
         flight_recorder_incidents_total.inc(reason=reason)

@@ -120,6 +120,39 @@ class ServingEndpoints:
                     respond_json(
                         {"levels": fc.summary() if fc is not None else None}
                     )
+                elif path == "/debug/profile":
+                    # PROFILE=1 continuous-profiler snapshot (ISSUE 15):
+                    # per-region self/total + compile/run split + phases +
+                    # per-consumer attribution + HBM watermarks. ?region=
+                    # narrows to one declared hot region, ?limit= to the
+                    # top-N by self time; bad args are a 400, same contract
+                    # as /debug/traces
+                    from ..analysis import hotregions
+                    from ..utils import profiler
+
+                    region = query.get("region")
+                    if region is not None:
+                        try:
+                            hotregions.get(region)
+                        except KeyError:
+                            declared = sorted(r.name for r in hotregions.REGIONS)
+                            respond_json(
+                                {"error": f"unknown region {region!r}; "
+                                          f"declared: {declared}"},
+                                400,
+                            )
+                            return
+                    limit = None
+                    if "limit" in query:
+                        try:
+                            limit = int(query["limit"])
+                        except ValueError:
+                            respond_json({"error": "limit must be an integer"}, 400)
+                            return
+                        if limit < 0:
+                            respond_json({"error": "limit must be >= 0"}, 400)
+                            return
+                    respond_json(profiler.snapshot(region=region, limit=limit))
                 elif path == "/debug/incidents":
                     rec = serving._recorder()
                     if "id" in query:
@@ -190,6 +223,8 @@ class ServingEndpoints:
             b"flight-recorder incident bundles (?id=)</li>"
             b'<li><a href="/debug/flowcontrol">/debug/flowcontrol</a> &mdash; '
             b"API priority &amp; fairness levels (seats, queue, shed)</li>"
+            b'<li><a href="/debug/profile">/debug/profile</a> &mdash; '
+            b"PROFILE=1 hot-region timings (?region=, ?limit=)</li>"
             b'<li><a href="/healthz">/healthz</a></li>'
             b"</ul></body></html>\n"
         )

@@ -53,7 +53,7 @@ from ..models.decode import NEG_INF, _finish_layer, prefill
 from ..models.transformer import TransformerConfig, layer_qkv
 from ..ops import rms_norm
 from ..tpu import telemetry
-from ..utils import jaxguard, racecheck
+from ..utils import jaxguard, profiler, racecheck
 from ..utils.tracing import record_span
 from . import metrics as M
 
@@ -315,8 +315,18 @@ class ServingEngine:
     def step(self) -> bool:
         """Admit queued requests into free slots, then run one decode BURST
         (`decode_burst` tokens per active slot in a single dispatch).
-        Returns False when there was nothing to do."""
-        admitted = self._admit()
+        Returns False when there was nothing to do.
+
+        Under PROFILE=1 the whole iteration is one serving.decode_burst
+        profiler region decomposed into admit -> prefill -> scan ->
+        batched_drain -> emit phases (the jaxguard burst guard inside is a
+        re-entry and does not double-count)."""
+        with profiler.region("serving.decode_burst", consumer="engine"):
+            return self._step()
+
+    def _step(self) -> bool:
+        with profiler.phase("admit"):
+            admitted = self._admit()
         n_active = sum(h is not None for h in self._slots)
         if n_active == 0:
             self._publish_gauges()
@@ -324,7 +334,7 @@ class ServingEngine:
         burst = self.decode_burst
         t0 = self.clock()
         transfers_before = jaxguard.transfer_count()
-        with self._burst_guard:
+        with profiler.phase("scan"), self._burst_guard:
             (
                 self._caches, lengths, tokens, remaining, toks, actives
             ) = _decode_burst(
@@ -344,9 +354,10 @@ class ServingEngine:
         # burst in ONE host sync (was five — a 5x on the tunnel round-trip
         # floor per burst; see BENCH serving delta). Outside the guarded
         # region by design: the burst itself holds transfer budget 0.
-        lengths, tokens, remaining, toks, actives = jax.device_get(  # lint: disable=host-transfer
-            (lengths, tokens, remaining, toks, actives)
-        )
+        with profiler.phase("batched_drain"):
+            lengths, tokens, remaining, toks, actives = jax.device_get(  # lint: disable=host-transfer
+                (lengths, tokens, remaining, toks, actives)
+            )
         # .copy(): device_get hands back read-only views, and the
         # admission path writes these slots in place
         self._lengths = lengths.copy()
@@ -361,12 +372,13 @@ class ServingEngine:
         self._decode_steps += burst
         per_step = burst_dt / burst
         telemetry.observe_decode_step(per_step, tokens=n_active)
-        for t in range(burst):
-            step_t = t0 + (t + 1) * per_step
-            for j, handle in enumerate(self._slots):
-                if handle is None or not actives[t, j]:
-                    continue
-                self._emit(j, handle, int(toks[t, j]), step_t)
+        with profiler.phase("emit"):
+            for t in range(burst):
+                step_t = t0 + (t + 1) * per_step
+                for j, handle in enumerate(self._slots):
+                    if handle is None or not actives[t, j]:
+                        continue
+                    self._emit(j, handle, int(toks[t, j]), step_t)
         self._publish_gauges()
         return True
 
@@ -387,7 +399,9 @@ class ServingEngine:
                 handle = self._queue.popleft()
                 M.inference_queue_depth.set(float(len(self._queue)))
             prompt = jnp.asarray([handle.prompt], jnp.int32)
-            with self._prefill_guard:
+            # nested inside the step's "admit" phase: admit self-time is the
+            # scheduling overhead, "prefill" is the model work
+            with profiler.phase("prefill"), self._prefill_guard:
                 logits, cache = _prefill_jit(
                     self.params, prompt, self.cfg, self.max_seq
                 )

@@ -21,8 +21,15 @@ from __future__ import annotations
 from typing import Iterable, Optional, Sequence, Tuple
 
 from ..runtime.metrics import global_registry
+from ..utils import profiler
 
 _STEP_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)
+# decode needs sub-ms resolution the train buckets don't: a v5e decode step
+# lands around 0.5-1ms/token (BENCH_r05: 10k tok/s single-slot), so the
+# shared seconds-leaning buckets collapsed the entire observed range into
+# the first bucket (metrics_lint bucket-coverage rule, ISSUE 15)
+_DECODE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                   0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30)
 
 train_step_seconds = global_registry.histogram(
     "tpu_train_step_duration_seconds",
@@ -33,7 +40,7 @@ train_step_seconds = global_registry.histogram(
 decode_step_seconds = global_registry.histogram(
     "tpu_decode_step_duration_seconds",
     "Per-token wall-clock of autoregressive decode",
-    buckets=_STEP_BUCKETS,
+    buckets=_DECODE_BUCKETS,
 )
 tokens_per_second = global_registry.gauge(
     "tpu_tokens_per_second",
@@ -151,10 +158,18 @@ def record_device_memory(
     mems: Iterable[Tuple[Optional[float], Optional[float]]]
 ) -> None:
     """Publish per-device bytes-in-use from (bytes_in_use, num_allocs) pairs
-    (the probe agent's sampler shape); devices are labeled by local index."""
+    (the probe agent's sampler shape); devices are labeled by local index.
+    Under PROFILE=1 the max across devices also feeds the profiler's
+    per-region HBM watermarks — the sampler the agent already runs doubles
+    as the profiler's memory probe, zero extra device round-trips."""
+    peak: Optional[float] = None
     for i, (bytes_in_use, _allocs) in enumerate(mems):
         if bytes_in_use is not None:
             device_memory_bytes.set(float(bytes_in_use), device=str(i))
+            if peak is None or float(bytes_in_use) > peak:
+                peak = float(bytes_in_use)
+    if peak is not None:
+        profiler.on_device_memory(peak)
 
 
 def update_device_memory() -> int:
@@ -168,6 +183,8 @@ def update_device_memory() -> int:
     except Exception:
         return 0
     published = 0
+    peak: Optional[float] = None
+    limit: Optional[float] = None
     for i, d in enumerate(devices):
         try:
             stats = getattr(d, "memory_stats", lambda: None)()
@@ -176,4 +193,10 @@ def update_device_memory() -> int:
         if stats and stats.get("bytes_in_use") is not None:
             device_memory_bytes.set(float(stats["bytes_in_use"]), device=str(i))
             published += 1
+            if peak is None or float(stats["bytes_in_use"]) > peak:
+                peak = float(stats["bytes_in_use"])
+            if stats.get("bytes_limit") is not None:
+                limit = float(stats["bytes_limit"])
+    if peak is not None:
+        profiler.on_device_memory(peak, limit_bytes=limit)
     return published

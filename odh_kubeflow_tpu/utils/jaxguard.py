@@ -46,9 +46,11 @@ from __future__ import annotations
 import functools
 import os
 import threading
+from time import perf_counter as _perf_counter
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..analysis import hotregions
+from . import profiler
 
 
 def enabled() -> bool:
@@ -186,6 +188,25 @@ def _donated_positions(jit_kwargs: Dict[str, Any]) -> Tuple[int, ...]:
     return tuple(donate)
 
 
+def _profiled_dispatch(call: Callable[..., Any], fn: Callable[..., Any],
+                       region_name: str) -> Callable[..., Any]:
+    """Under PROFILE=1, time each dispatch of the guarded jit (host-side
+    wall; includes trace time on a cache miss) and report it to the
+    profiler. One `enabled()` check per call when off — the same cost bar
+    as the guard itself."""
+
+    @functools.wraps(fn)
+    def dispatch(*args: Any, **kwargs: Any) -> Any:
+        if not profiler.enabled():
+            return call(*args, **kwargs)
+        t0 = _perf_counter()
+        out = call(*args, **kwargs)
+        profiler.on_jit_call(region_name, _perf_counter() - t0)
+        return out
+
+    return dispatch
+
+
 def jit(fn: Optional[Callable[..., Any]] = None, *, region: str,
         **jit_kwargs: Any) -> Callable[..., Any]:
     """`jax.jit` with a compile counter attributed to `region` (always on —
@@ -202,12 +223,19 @@ def jit(fn: Optional[Callable[..., Any]] = None, *, region: str,
     @functools.wraps(fn)
     def traced(*args: Any, **kwargs: Any) -> Any:
         _on_trace(region)
-        return fn(*args, **kwargs)
+        if not profiler.enabled():
+            return fn(*args, **kwargs)
+        # PROFILE=1 (ISSUE 15): the wrapper body only runs while jax is
+        # (re)tracing, so its wall time IS the python-side compile cost
+        t0 = _perf_counter()
+        out = fn(*args, **kwargs)
+        profiler.on_compile(region, _perf_counter() - t0)
+        return out
 
     jitted = jax.jit(traced, **jit_kwargs)
     donate = _donated_positions(jit_kwargs)
     if not donate:
-        return jitted
+        return _profiled_dispatch(jitted, fn, region)
 
     @functools.wraps(fn)
     def call(*args: Any, **kwargs: Any) -> Any:
@@ -232,7 +260,7 @@ def jit(fn: Optional[Callable[..., Any]] = None, *, region: str,
             )
         return out
 
-    return call
+    return _profiled_dispatch(call, fn, region)
 
 
 # ---------------------------------------------------------------------------
@@ -255,6 +283,7 @@ class region:
         self._compiles_seen = 0  # traces attributed while this is innermost
         self._entry_transfers = 0
         self._armed = False
+        self._prof_token: Any = None
 
     @property
     def compiles(self) -> int:
@@ -262,6 +291,10 @@ class region:
         return self._compiles_seen
 
     def __enter__(self) -> "region":
+        # PROFILE=1 times guarded regions even when the guard itself is off
+        # (region_enter no-ops on re-entry, so the burst guard inside the
+        # engine's step-wide profiler scope never double-counts)
+        self._prof_token = profiler.region_enter(self.name)
         if not enabled():
             return self
         self._armed = True
@@ -271,6 +304,8 @@ class region:
         return self
 
     def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
+        token, self._prof_token = self._prof_token, None
+        profiler.region_exit(token)
         if not self._armed:
             return
         self._armed = False
