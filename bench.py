@@ -600,6 +600,151 @@ def _decode_point(cfg, batch, prompt_len, max_new, short_new, max_seq):
     }
 
 
+def _bench_fleet_episode():
+    """ISSUE 16 serving-fleet numbers, scripted and deterministic.
+
+    Two headlines ride this episode (bench/ledger.py):
+
+    - **router_added_latency_p50_ms** — the per-request tax of the
+      health-aware token router over the bare engine at the same request
+      shape: p50(router path) - p50(direct submit/wait). Signal scoring,
+      breaker bookkeeping, and the result wait loop are all it can spend;
+      the tolerance is wide because sub-millisecond host scheduling noise
+      dominates an in-process measurement.
+    - **scale_up_reaction_s** — hot autoscaler tick to new replica
+      Serving: the annotation write, the endpoint controller's warm bind
+      from the slice pool, and gang readiness, end to end.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import TransformerConfig, init_params
+    from odh_kubeflow_tpu.serving.engine import ServingEngine
+    from odh_kubeflow_tpu.serving.router import TokenRouter
+
+    tiny = TransformerConfig(
+        vocab=256, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=128, dtype=jnp.float32, use_flash=False,
+        remat=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), tiny)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    n_req, max_new = 40, 8
+
+    def pct50(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    engines = [
+        ServingEngine(params, tiny, max_slots=4, max_seq=128,
+                      max_queue_depth=n_req + 1).start()
+        for _ in range(2)
+    ]
+    try:
+        # warm both paths (compile + thread spin-up) before timing
+        engines[0].submit(prompt, max_new=2).wait(30)
+        direct = []
+        for _ in range(n_req):
+            t0 = time.perf_counter()
+            h = engines[0].submit(prompt, max_new=max_new)
+            h.wait(30)
+            direct.append(time.perf_counter() - t0)
+
+        router = TokenRouter(endpoint="bench/fleet")
+        for idx, eng in enumerate(engines):
+            router.add_replica(idx, eng)
+        router.generate(prompt, max_new=2, wait_timeout_s=30)
+        routed = []
+        for _ in range(n_req):
+            t0 = time.perf_counter()
+            router.generate(prompt, max_new=max_new, wait_timeout_s=30)
+            routed.append(time.perf_counter() - t0)
+    finally:
+        for eng in engines:
+            eng.stop()
+    router_added_ms = (pct50(routed) - pct50(direct)) * 1e3
+
+    # -- hot tick -> Serving: annotation write, warm bind, gang ready --
+    from odh_kubeflow_tpu.api.core import Container
+    from odh_kubeflow_tpu.api.inference import (
+        AutoscalingSpec, InferenceEndpoint, ServingSpec,
+    )
+    from odh_kubeflow_tpu.api.notebook import TPUSpec
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.controllers import Config, constants as CC
+    from odh_kubeflow_tpu.controllers.inference import (
+        endpoint_desired_replicas,
+    )
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.runtime.autoscaler import ReplicaAutoscaler
+
+    cluster = SimCluster().start()
+    cluster.add_tpu_pool("bench", "v5e", "2x2", slices=4)
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+    config = Config(
+        enable_culling=False, readiness_probe_period_s=0.15,
+        serving_loading_window_s=10.0, serving_drain_timeout_s=0.5,
+        slo_enabled=False, canary_period_s=0.0,
+    )
+    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    mgr.start()
+    try:
+        ep = InferenceEndpoint()
+        ep.metadata.name = "fleet-bench"
+        ep.metadata.namespace = "bench"
+        ep.spec.template.spec.containers = [
+            Container(name="fleet-bench", image="serve:1")
+        ]
+        ep.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+        ep.spec.serving = ServingSpec(
+            max_batch_slots=2, replicas=1,
+            autoscaling=AutoscalingSpec(min_replicas=1, max_replicas=2),
+        )
+        cluster.client.create(ep)
+
+        def serving_replicas():
+            got = cluster.client.get(InferenceEndpoint, "bench",
+                                     "fleet-bench")
+            return got.status.serving_replicas
+
+        def wait(fn, timeout, what):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if fn():
+                    return
+                time.sleep(0.02)
+            raise SystemExit(f"fleet episode: timeout on {what}")
+
+        wait(lambda: serving_replicas() >= 1, 60, "endpoint Serving")
+        scaler = ReplicaAutoscaler(
+            mgr, period_s=9999.0,
+            signals_fn=lambda _ep: {"burn_rate": 10.0, "queue_depth": 99.0,
+                                    "slot_occupancy": 1.0},
+        )
+        t0 = time.monotonic()
+        scaler.tick()
+        desired = endpoint_desired_replicas(
+            cluster.client.get(InferenceEndpoint, "bench", "fleet-bench")
+        )
+        wait(lambda: serving_replicas() >= desired, 60,
+             "autoscaled replica Serving")
+        scale_up_reaction_s = time.monotonic() - t0
+    finally:
+        mgr.stop()
+        cluster.stop()
+
+    return {
+        "router_added_latency_p50_ms": round(router_added_ms, 3),
+        "scale_up_reaction_s": round(scale_up_reaction_s, 3),
+        "requests_per_path": n_req,
+        "replicas": 2,
+        "note": "tiny-model in-process episode: gates structure and "
+                "order-of-magnitude, not chip speed",
+    }
+
+
 def bench_serving():
     """Continuous batching vs the static-batch generate() baseline at EQUAL
     batch slots under a mixed-length request stream (ISSUE 9 acceptance:
@@ -773,6 +918,9 @@ def bench_serving():
         # profiler's device-memory feed (null on a backend without
         # memory_stats, e.g. the CPU proxy)
         "hbm_headroom": profiler.hbm_stats(),
+        # ISSUE 16: the serving-fleet episode (router tax + autoscale
+        # reaction) — its two numbers are declared ledger headlines
+        "fleet": _bench_fleet_episode(),
     }
 
 

@@ -100,6 +100,28 @@ HEADLINES: Tuple[Headline, ...] = (
              "is null until the first TPU run after ISSUE 9 lands one",
     ),
     Headline(
+        name="router_added_latency_p50_ms",
+        path=("detail", "serving", "fleet", "router_added_latency_p50_ms"),
+        direction="lower",
+        tolerance=0.75,
+        note="in-process router tax (p50 routed - p50 direct, tiny model); "
+             "sub-ms host scheduling noise dominates, so only "
+             "order-of-magnitude breaks should gate; no committed round "
+             "carries it yet (vs_prior null until the first post-ISSUE 16 "
+             "bench round)",
+    ),
+    Headline(
+        name="scale_up_reaction_s",
+        path=("detail", "serving", "fleet", "scale_up_reaction_s"),
+        direction="lower",
+        tolerance=0.75,
+        note="hot autoscaler tick -> new replica Serving in the in-process "
+             "sim (annotation write + warm bind + gang readiness); "
+             "dominated by probe cadence and host scheduling, wide "
+             "tolerance catches order-of-magnitude breaks only; no "
+             "committed round carries it yet",
+    ),
+    Headline(
         name="cr_to_mesh_ready_p50_s",
         path=("detail", "control_plane", "cr_to_mesh_ready_p50_s"),
         direction="lower",

@@ -192,4 +192,31 @@ DEPLOYGUARD=1 DEPLOYGUARD_SURFACE_OUT="${DEPLOYGUARD_SURFACE_OUT:-}" \
     -q -m "(overload or flowcontrol) and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, +1 jaxguard +1 deployguard on serving/job/overload, incl. slice chaos + pool churn + serving + job + overload) ==="
+# router lane (ISSUE 16): the serving-fleet resilience surface — breaker
+# ejection/re-admission, cross-replica retries, hedging cancels the loser,
+# route-first drain with zero dropped in-flight requests, cold-wake, the
+# SLO-burn autoscaler's stabilization damping + min-replicas floor, and the
+# seeded router bad day's determinism — rerun under the stress loop + one
+# RACECHECK=1, one INVCHECK=1, and one DEPLOYGUARD=1 iteration (the router's
+# cold-wake patch and the autoscaler sweep are manager flows, so their
+# traffic is RBAC-enforced at the call)
+for i in $(seq 1 "$REPEAT"); do
+    echo "=== router lane: iteration $i/$REPEAT ==="
+    python -m pytest tests/test_router.py tests/test_autoscaler.py \
+        -q -m "(router or autoscaler) and not slow" \
+        -p no:cacheprovider -p no:randomly "$@"
+done
+echo "=== router lane: RACECHECK=1 iteration ==="
+RACECHECK=1 python -m pytest tests/test_router.py tests/test_autoscaler.py \
+    -q -m "(router or autoscaler) and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+echo "=== router lane: INVCHECK=1 iteration ==="
+INVCHECK=1 python -m pytest tests/test_router.py tests/test_autoscaler.py \
+    -q -m "(router or autoscaler) and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+echo "=== router lane: DEPLOYGUARD=1 iteration ==="
+DEPLOYGUARD=1 python -m pytest tests/test_router.py tests/test_autoscaler.py \
+    -q -m "(router or autoscaler) and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== faults lane: $REPEAT/$REPEAT iterations green (+1 racecheck +1 invcheck, +1 jaxguard +1 deployguard on serving/job/overload, incl. slice chaos + pool churn + serving + job + overload + router) ==="

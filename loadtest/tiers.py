@@ -21,6 +21,18 @@ tier is the CI lane (ci/loadtest.sh); the 500-object tier is the slow one:
 
   python loadtest/tiers.py --objects 200
   python loadtest/tiers.py --objects 500
+
+The multi-replica serving tier (ISSUE 16) drives an open-loop token stream
+through the health-aware router against a replicated InferenceEndpoint
+fleet, enacts the seeded router bad day (one whole replica gang preempted
+mid-stream, one surviving replica slowed, probe flaps, the control-plane
+schedule), forces one autoscale-up through the real ReplicaAutoscaler
+decision path, and reads its verdict from the token-latency /
+serving-availability SLO statuses + firing alerts — with zero dropped
+in-flight requests and the batch/default flow levels never starved by
+router traffic:
+
+  python loadtest/tiers.py --tier fleet
 """
 from __future__ import annotations
 
@@ -39,6 +51,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # are the verdict (ISSUE 13 acceptance list)
 GATED_SLOS = ("readiness-latency-p99", "canary-readiness", "job-completion",
               "serving-availability")
+
+# the serving-fleet tier's verdict SLOs (ISSUE 16): what the open-loop
+# stream through the router actually exercises
+FLEET_GATED_SLOS = ("token-latency", "serving-availability")
 
 STEP_PER_CKPT = 30
 JOB_STREAMS = 6
@@ -569,6 +585,445 @@ def run(args) -> None:
         raise SystemExit(1)
 
 
+def run_fleet(args) -> None:
+    """The multi-replica serving tier (ISSUE 16). Exit status is the SLO
+    verdict; "zero dropped in-flight requests" is a hard gate — every
+    routed request must end `ok` or be a client-visible 429 shed, never
+    vanish."""
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.api.core import ConfigMap, Container, Pod
+    from odh_kubeflow_tpu.api.inference import (
+        AutoscalingSpec,
+        InferenceEndpoint,
+        ServingSpec,
+    )
+    from odh_kubeflow_tpu.api.job import TPUJob
+    from odh_kubeflow_tpu.api.notebook import TPUSpec
+    from odh_kubeflow_tpu.apimachinery import (
+        NotFoundError,
+        TooManyRequestsError,
+    )
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.cluster.faults import seeded_router_bad_day
+    from odh_kubeflow_tpu.cluster.flowcontrol import FlowController
+    from odh_kubeflow_tpu.controllers import Config, constants as C
+    from odh_kubeflow_tpu.controllers.inference import (
+        endpoint_desired_replicas,
+    )
+    from odh_kubeflow_tpu.main import build_manager
+    from odh_kubeflow_tpu.models import TransformerConfig, init_params
+    from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.runtime.autoscaler import ReplicaAutoscaler
+    from odh_kubeflow_tpu.serving.engine import QueueFull, ServingEngine
+    from odh_kubeflow_tpu.serving.router import TokenRouter
+
+    ns = args.namespace
+    name = "fleet"
+    duration = args.duration or 25.0
+    setup_budget = 120.0
+
+    cluster = SimCluster().start()
+    fc = FlowController()  # the default layout includes the serving level
+    cluster.store.flowcontrol = fc
+    cluster.add_tpu_pool("fleet", "v5e", "2x2", slices=6)
+    agents = {}
+    cluster.add_pod_behavior(sim_agent_behavior(agents, duty=0.9))
+
+    config = Config(
+        enable_culling=False,
+        suspend_enabled=True,
+        readiness_probe_period_s=0.15,
+        serving_loading_window_s=10.0,
+        serving_drain_timeout_s=0.5,
+        slo_enabled=True,
+        slo_window_scale=max(1e-4, duration / 600.0),
+        # the router knobs ride the ENV_CONTRACT like every other knob; the
+        # tier consumes them from the same Config the manager runs on
+        router_eject_failures=3,
+        router_hedge_after_s=0.5,
+    )
+    mgr = build_manager(cluster.store, config, http_get=cluster.http_get)
+    mgr.start()
+
+    driver = cluster.client
+    result = {"tier": "fleet", "duration_s": round(duration, 1)}
+    failures = []
+
+    def wait_for(fn, timeout, msg):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if fn():
+                    return time.monotonic()
+            except TooManyRequestsError:
+                pass
+            time.sleep(0.05)
+        raise SystemExit(f"fleet tier timeout: {msg}")
+
+    def get_ep():
+        return driver.get(InferenceEndpoint, ns, name)
+
+    def serving_replicas():
+        try:
+            return get_ep().status.serving_replicas
+        except TooManyRequestsError:
+            return -1
+
+    def replica_nodes_map():
+        out = {}
+        for pod in driver.list(Pod, namespace=ns):
+            labels = pod.metadata.labels
+            if labels.get(C.INFERENCE_NAME_LABEL) != name:
+                continue
+            if not pod.spec.node_name:
+                continue
+            idx = int(labels.get(C.INFERENCE_REPLICA_LABEL, "0"))
+            out.setdefault(idx, []).append(pod.spec.node_name)
+        return out
+
+    cfg = TransformerConfig(
+        vocab=256, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=64, max_seq=128, dtype=jnp.float32, use_flash=False,
+        remat=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+
+    def mk_engine():
+        return ServingEngine(
+            params, cfg, max_slots=4, max_seq=128, max_queue_depth=32,
+            decode_burst=8,
+        ).start()
+
+    class SlowEngine:
+        """The bad-day plan's slow replica, applied at the engine boundary:
+        every handoff pays the seeded latency factor, so the router's
+        TTFT-tail scoring and hedging must route around it."""
+
+        def __init__(self, engine, delay_s):
+            self.engine = engine
+            self.delay_s = delay_s
+
+        def submit(self, prompt, max_new, traceparent=None):
+            time.sleep(self.delay_s)
+            return self.engine.submit(prompt, max_new, traceparent)
+
+        def stats(self):
+            return self.engine.stats()
+
+        def cancel(self, handle):
+            return self.engine.cancel(handle)
+
+    engines = {}
+    stream = {"ok": 0, "shed": 0, "dropped": 0, "hedged": 0, "retried": 0}
+    stream_lock = threading.Lock()
+    errors = []
+    stop_stream = threading.Event()
+    pace = threading.Semaphore(0)
+
+    try:
+        # ------------------------------------------------------------------
+        # fleet bring-up: replicas=2, autoscaling 1..3
+        # ------------------------------------------------------------------
+        ep = InferenceEndpoint()
+        ep.metadata.name = name
+        ep.metadata.namespace = ns
+        ep.spec.template.spec.containers = [
+            Container(name=name, image="serve:1")
+        ]
+        ep.spec.tpu = TPUSpec(accelerator="v5e", topology="2x2")
+        ep.spec.serving = ServingSpec(
+            max_batch_slots=4, max_queue_depth=32, max_seq=128,
+            max_new_tokens=16, replicas=2,
+            autoscaling=AutoscalingSpec(min_replicas=1, max_replicas=3),
+        )
+        driver.create(ep)
+        wait_for(
+            lambda: get_ep().metadata.annotations.get(
+                C.INFERENCE_STATE_ANNOTATION) == "serving"
+            and serving_replicas() >= 2,
+            setup_budget, "fleet Serving at 2 replicas",
+        )
+
+        router = TokenRouter(
+            endpoint=f"{ns}/{name}",
+            flow_controller=fc,
+            breaker_failure_threshold=config.router_eject_failures,
+            hedge_after_s=config.router_hedge_after_s,
+        )
+        for idx in (0, 1):
+            engines[idx] = mk_engine()
+            router.add_replica(idx, engines[idx])
+
+        # ------------------------------------------------------------------
+        # open-loop stream through the router (feeds token-latency +
+        # serving-availability) + background batch/default traffic that must
+        # NEVER be starved by it
+        # ------------------------------------------------------------------
+        def request_worker(widx):
+            rng = random.Random(1000 + widx)
+            while True:
+                pace.acquire()
+                if stop_stream.is_set():
+                    return
+                prompt = [rng.randrange(cfg.vocab) for _ in range(8)]
+                try:
+                    res = router.generate(
+                        prompt, max_new=rng.choice((8, 12, 16)),
+                        wait_timeout_s=30.0,
+                    )
+                    with stream_lock:
+                        if res.handle.result == "ok":
+                            stream["ok"] += 1
+                        else:
+                            stream["dropped"] += 1
+                        if res.hedged:
+                            stream["hedged"] += 1
+                        if res.retries:
+                            stream["retried"] += 1
+                except QueueFull:
+                    with stream_lock:
+                        stream["shed"] += 1
+                except Exception as e:  # a vanished request is a DROP
+                    with stream_lock:
+                        stream["dropped"] += 1
+                        errors.append(repr(e))
+
+        workers = [
+            threading.Thread(target=request_worker, args=(w,), daemon=True)
+            for w in range(12)
+        ]
+        for w in workers:
+            w.start()
+
+        def pacer():
+            period = 1.0 / max(0.1, args.qps)
+            while not stop_stream.is_set():
+                pace.release()
+                stop_stream.wait(period)
+
+        pacer_thread = threading.Thread(target=pacer, daemon=True)
+
+        fair = {"batch": 0, "default": 0}
+        stop_fair = threading.Event()
+
+        def fairness_driver():
+            # anonymous read probes classified by KIND: TPUJob -> the batch
+            # level, ConfigMap -> default. A NotFound is a successful probe
+            # (admission happened); a 429 surfaces in the level's shed
+            # counters, which the starvation gate below reads.
+            while not stop_fair.is_set():
+                for kind, level in ((TPUJob, "batch"), (ConfigMap, "default")):
+                    try:
+                        driver.get(kind, ns, "fairness-probe")
+                        fair[level] += 1
+                    except NotFoundError:
+                        fair[level] += 1
+                    except TooManyRequestsError:
+                        pass
+                stop_fair.wait(0.05)
+
+        fair_before = {
+            level: fc.summary()[level]["rejected"]
+            + fc.summary()[level]["timed_out"]
+            for level in ("batch", "default")
+        }
+        fairness_thread = threading.Thread(target=fairness_driver,
+                                           daemon=True)
+        fairness_thread.start()
+        pacer_thread.start()
+
+        t_run = time.monotonic()
+        deadline = t_run + duration
+        time.sleep(duration * 0.25)
+
+        # ------------------------------------------------------------------
+        # the seeded router bad day: one whole replica gang preempted
+        # mid-stream, one survivor slowed, probe flaps, the control-plane
+        # schedule — then the fleet must return to strength through the
+        # repair/warm-pool paths with zero dropped in-flight requests
+        # ------------------------------------------------------------------
+        plan = seeded_router_bad_day(
+            cluster, seed=args.seed, replica_nodes=replica_nodes_map(),
+            grace_s=0.5,
+        )
+        victim = plan["killed_replica"]
+        slow = plan["slow_replica"]
+        if slow is not None:
+            router.add_replica(
+                slow,
+                SlowEngine(engines[slow],
+                           delay_s=0.01 * plan["slow_factor"]),
+            )
+        # the victim replica leaves rotation FIRST (route-first, exactly the
+        # drain ordering the controller uses), then its engine dies with a
+        # bounded drain — in-flight work completes or comes back `canceled`,
+        # and canceled is retried on a different replica by the router
+        router.remove_replica(victim)
+        victim_engine = engines.pop(victim)
+        threading.Thread(
+            target=lambda: victim_engine.stop(drain_timeout_s=8.0),
+            daemon=True,
+        ).start()
+
+        t_killed = time.monotonic()
+        replaced_at = wait_for(
+            lambda: serving_replicas() >= 2,
+            setup_budget, "killed replica re-placed",
+        )
+        result["replica_replace_s"] = round(replaced_at - t_killed, 2)
+        engines[victim] = mk_engine()
+        router.add_replica(victim, engines[victim])
+
+        # ------------------------------------------------------------------
+        # one forced autoscale-up through the REAL decision path: a hot
+        # signal pushed through ReplicaAutoscaler.tick() writes the
+        # desired-replicas annotation; the controller's scale-up is a warm
+        # bind from the pool
+        # ------------------------------------------------------------------
+        scaler = ReplicaAutoscaler(
+            mgr, period_s=9999.0,
+            signals_fn=lambda _ep: {"burn_rate": 10.0, "queue_depth": 99.0,
+                                    "slot_occupancy": 1.0},
+        )
+        before_up = endpoint_desired_replicas(get_ep())
+        t_scale = time.monotonic()
+        # the bad day's throttle rules can 429 any single annotation
+        # patch; the real autoscaler just retries next period, so the
+        # forced decision ticks until the write lands (bounded)
+        after_up = before_up
+        tick_deadline = time.monotonic() + 15.0
+        while time.monotonic() < tick_deadline:
+            scaler.tick()
+            try:
+                after_up = endpoint_desired_replicas(get_ep())
+            except TooManyRequestsError:
+                after_up = before_up
+            if after_up > before_up:
+                break
+            time.sleep(0.1)
+        if after_up != before_up + 1:
+            failures.append(
+                f"forced autoscale-up did not move desired replicas "
+                f"({before_up} -> {after_up})"
+            )
+        scaled_at = wait_for(
+            lambda: serving_replicas() >= after_up,
+            setup_budget, "autoscale-up replica Serving",
+        )
+        result["scale_up_reaction_s"] = round(scaled_at - t_scale, 2)
+        new_idx = max(
+            set(range(after_up)) - set(router.replicas()),
+            default=after_up - 1,
+        )
+        engines[new_idx] = mk_engine()
+        router.add_replica(new_idx, engines[new_idx])
+
+        # ------------------------------------------------------------------
+        # ride out the rest of the tier, then drain the stream
+        # ------------------------------------------------------------------
+        while time.monotonic() < deadline:
+            time.sleep(0.1)
+        stop_stream.set()
+        for _ in workers:
+            pace.release()
+        pacer_thread.join(timeout=5)
+        for w in workers:
+            w.join(timeout=45)
+        stop_fair.set()
+        fairness_thread.join(timeout=5)
+        for engine in engines.values():
+            engine.stop(drain_timeout_s=10.0)
+
+        # ------------------------------------------------------------------
+        # gates: zero drops, fairness, the SLO verdict
+        # ------------------------------------------------------------------
+        if stream["dropped"]:
+            failures.append(
+                f"{stream['dropped']} in-flight request(s) dropped: "
+                f"{errors[:3]}"
+            )
+        if not stream["ok"]:
+            failures.append("no request ever completed through the router")
+        summary = fc.summary()
+        for level in ("batch", "default"):
+            shed = (summary[level]["rejected"] + summary[level]["timed_out"]
+                    - fair_before[level])
+            if shed:
+                failures.append(
+                    f"{level} level shed {shed} request(s) under router "
+                    "traffic"
+                )
+        if not fair["batch"] or not fair["default"]:
+            failures.append("background batch/default traffic never flowed")
+        if summary["serving"]["dispatched"] <= 0:
+            failures.append("router traffic never rode the serving level")
+
+        statuses = mgr.slo_engine.evaluate()
+        alerts = mgr.alert_manager.status()
+        all_firing = sorted(
+            a.get("rule", a.get("name", "?"))
+            for a in alerts.get("firing", [])
+        )
+        firing = [
+            n for n in all_firing
+            if any(n.startswith(slo) for slo in FLEET_GATED_SLOS)
+        ]
+        gates = {}
+        ok = True
+        for slo_name in FLEET_GATED_SLOS:
+            st = statuses.get(slo_name, {})
+            compliance = st.get("compliance")
+            objective = st.get("objective")
+            passed = (
+                compliance is not None and objective is not None
+                and compliance >= objective
+            )
+            gates[slo_name] = {
+                "compliance": compliance,
+                "objective": objective,
+                "events": st.get("events"),
+                "passed": passed,
+            }
+            ok = ok and passed
+        ok = ok and not firing and not failures
+
+        result.update({
+            "bad_day_plan": plan,
+            "requests": dict(stream),
+            "fairness_probes": dict(fair),
+            "flowcontrol": {
+                level: {
+                    "dispatched": stats["dispatched"],
+                    "shed": stats["rejected"] + stats["timed_out"],
+                    "queued": stats["queued"],
+                    "p99_wait_s": stats["p99_wait_s"],
+                }
+                for level, stats in summary.items()
+            },
+            "slo_gates": gates,
+            "alerts_firing_gated": list(firing),
+            "alerts_firing_all": list(all_firing),
+            "failures": list(failures),
+            "passed": bool(ok),
+        })
+    finally:
+        stop_stream.set()
+        for _ in range(64):
+            pace.release()
+        for engine in engines.values():
+            try:
+                engine.stop()
+            except Exception:
+                pass
+        mgr.stop()
+        cluster.stop()
+    print(json.dumps(result, indent=2))
+    if not result.get("passed"):
+        raise SystemExit(1)
+
+
 def main() -> None:
     # deployment-surface guard (ISSUE 14): the tier always runs armed
     # (DEPLOYGUARD=0 opts out) — a shed-path or standby-takeover write that
@@ -577,14 +1032,25 @@ def main() -> None:
     # RBACDriftError at the call, not a silent fairness leak
     os.environ.setdefault("DEPLOYGUARD", "1")
     ap = argparse.ArgumentParser()
+    ap.add_argument("--tier", default="mixed", choices=("mixed", "fleet"),
+                    help="mixed: the 200/500-object control-plane tier; "
+                         "fleet: the multi-replica serving tier (ISSUE 16)")
     ap.add_argument("--objects", type=int, default=200, choices=(200, 500),
-                    help="tier size: 200 (CI lane) or 500 (slow tier)")
+                    help="mixed-tier size: 200 (CI lane) or 500 (slow tier)")
     ap.add_argument("--duration", type=float, default=0.0,
                     help="steady-state seconds after bring-up "
-                         "(0 = scale with --objects)")
+                         "(0 = scale with the tier)")
     ap.add_argument("--qps", type=float, default=12.0)
+    ap.add_argument("--seed", type=int, default=16,
+                    help="fleet tier: the seeded_router_bad_day seed")
     ap.add_argument("--namespace", default="tiers")
-    run(ap.parse_args())
+    args = ap.parse_args()
+    if args.tier == "fleet":
+        if args.qps == 12.0:
+            args.qps = 8.0  # the fleet default: open-loop but sustainable
+        run_fleet(args)
+    else:
+        run(args)
 
 
 if __name__ == "__main__":

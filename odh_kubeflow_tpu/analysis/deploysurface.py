@@ -131,6 +131,10 @@ MANAGER_FLOWS: FrozenSet[str] = frozenset(
         "inference-endpoint",
         "tpu-job",
         "canary",
+        # ISSUE 16: the autoscaler sweep and the router's cold-wake patch
+        # are manager traffic — RBAC-enforced like every controller flow
+        "endpoint-autoscaler",
+        "token-router",
     }
 )
 

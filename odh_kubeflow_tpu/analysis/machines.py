@@ -255,7 +255,10 @@ INFERENCE_MACHINE = MachineSpec(
     doc="Notebook->serving promotion: a Pending endpoint warm-binds its "
         "source notebook's released slice, Loading restores+verifies the "
         "checkpoint, Serving holds the route, and a stop drains bounded "
-        "before the slice is released back warm.",
+        "before the slice is released back warm. ISSUE 16 grows the machine "
+        "a scale-to-zero edge: an idle fleet parks Suspended with the route "
+        "left up, and the first request (or any desired-replicas bump) "
+        "cold-wakes it through a fresh Pending episode.",
     states=(
         State("", "Pending",
               "STS/services converging; pods scheduling (warm claim bound "
@@ -277,6 +280,10 @@ INFERENCE_MACHINE = MachineSpec(
         State("load-failed", "LoadFailed",
               "loading window expired or restore checksum mismatched",
               terminal=True, self_healing=True, incident=True),
+        State("suspended", "Suspended",
+              "scale-to-zero park (ISSUE 16): replicas 0, every slice "
+              "released warm, route left UP — the router's cold-wake (first "
+              "request) or a desired-replicas bump pops it back to Pending"),
     ),
     transitions=(
         Transition("", "loading", "inference.py:_run_pending",
@@ -304,6 +311,17 @@ INFERENCE_MACHINE = MachineSpec(
                    "drained (or deadline): replicas 0, slice released"),
         Transition("terminated", "", "inference.py:reconcile",
                    "unstop: serve again (a fresh Pending episode)"),
+        Transition("serving", "suspended", "inference.py:_park_suspended",
+                   "scale-to-zero: desired replicas 0 with "
+                   "autoscaling.scaleToZero — drain every replica warm, "
+                   "keep the route for the cold-wake"),
+        Transition("suspended", "", "inference.py:reconcile",
+                   "cold-wake: first request (router) or desired-replicas "
+                   "bump clears the park — a fresh Pending episode "
+                   "warm-binds from the pool"),
+        Transition("suspended", "draining", "inference.py:reconcile",
+                   "stopped while parked: wind down for real (route torn "
+                   "down, Terminated keeps nothing routable)"),
         Transition("*", "", "inference.py:reconcile",
                    "defensive clear of an unknown state value"),
     ),

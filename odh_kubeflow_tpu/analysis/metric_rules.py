@@ -40,6 +40,9 @@ HISTOGRAM_RANGES = {
     "tpu_slice_repair_duration_seconds": (0.1, 600.0),
     "inference_ttft_seconds": (0.001, 10.0),
     "inference_token_latency_seconds": (0.0005, 2.5),
+    # routing overhead: sub-ms pick in steady state, stretching toward the
+    # retry-budget cap (jittered backoffs) when replicas shed or fail
+    "inference_router_added_latency_seconds": (0.0005, 1.0),
     "profile_phase_seconds": (0.0001, 2.5),
     "profile_region_seconds": (0.0005, 30.0),
     "profile_compile_seconds": (0.001, 60.0),

@@ -36,6 +36,27 @@ class NotebookRef(KubeModel):
 
 
 @dataclass
+class AutoscalingSpec(KubeModel):
+    """SLO-burn autoscaling bounds (runtime/autoscaler.py). The signal is
+    burn rate / queue pressure from the SLO engine — never CPU. minReplicas
+    is a hard floor under sustained burn; maxReplicas caps how much of the
+    warm pool one endpoint may bind; scaleToZero allows parking the whole
+    fleet Suspended-with-a-route when idle (cold-wake on first request)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # scale up when the serving SLOs' fast-window burn rate crosses this
+    # (1.0 = burning exactly the error budget); 0 keeps the default
+    target_burn_rate: float = 2.0
+    scale_to_zero: bool = False
+    # flap damping: a scale-down (or park-to-zero) only fires after the
+    # signal has been below target for this long (0 -> controller default)
+    scale_down_stabilization_s: float = 0.0
+    # idle window before scale-to-zero parks the fleet (0 -> default)
+    scale_to_zero_idle_s: float = 0.0
+
+
+@dataclass
 class ServingSpec(KubeModel):
     """Continuous-batching engine shape (serving/engine.py): KV-cache slots,
     admission-queue bound, and sequence budget per request."""
@@ -52,6 +73,13 @@ class ServingSpec(KubeModel):
     # bounded drain: Draining waits this long for in-flight requests before
     # the gang scales away (0 -> the controller default)
     drain_timeout_s: float = 0.0
+    # serving fleet (ISSUE 16): N independent per-replica gangs, each its own
+    # STS + gang-DNS Service + slicepool claim. The endpoint stays Serving
+    # while >=1 replica is healthy (DegradedServing condition below full
+    # strength). The autoscaler moves the live count within
+    # autoscaling.{min,max}; `replicas` is the static default
+    replicas: int = 1
+    autoscaling: Optional[AutoscalingSpec] = None
 
 
 @dataclass
@@ -66,12 +94,19 @@ class InferenceEndpointSpec(KubeModel):
 @dataclass
 class InferenceEndpointStatus(KubeModel):
     conditions: List[Condition] = field(default_factory=list)
-    ready_replicas: int = 0
+    ready_replicas: int = 0  # ready HOSTS across the whole fleet
     # human mirror of the annotation-durable machine (the annotation is the
     # durable truth; this is for kubectl get)
     phase: str = ""
     tpu: Optional[TPUStatus] = None
-    url: str = ""  # route path once Serving
+    url: str = ""  # route path while Serving (or parked Suspended)
+    # fleet view (ISSUE 16) — the router's signal contract: `replicas` is
+    # the converged-toward fleet size, `servingReplicas` how many full gangs
+    # can take traffic, `drainingReplicas` which gang indexes are in their
+    # route-first drain window (the router must stop picking them)
+    replicas: int = 0
+    serving_replicas: int = 0
+    draining_replicas: List[int] = field(default_factory=list)
 
 
 @dataclass

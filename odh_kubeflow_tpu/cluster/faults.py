@@ -43,7 +43,7 @@ from __future__ import annotations
 
 import random
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..apimachinery import ConflictError, GoneError, TooManyRequestsError
 from ..utils import racecheck
@@ -436,6 +436,73 @@ def seeded_pool_bad_day(
             cluster.fail_node(node)
             plan["poisoned"].append(node)
     cluster.faults.reclaim_race(times=rng.randint(2, 6))
+    if cp_seed is not None:
+        seeded_bad_day(cluster.faults, seed=cp_seed)
+    return plan
+
+
+def seeded_router_bad_day(
+    cluster: Any,
+    seed: int,
+    replica_nodes: Dict[int, List[str]],
+    grace_s: float = 0.4,
+    control_plane: bool = True,
+    slow_factor_range: Tuple[float, float] = (2.0, 6.0),
+) -> Dict[str, Any]:
+    """One deterministic serving-fleet bad day (ISSUE 16): every victim
+    choice is drawn from random.Random(seed).
+
+    `replica_nodes` maps replica index -> the node names hosting that gang
+    (the caller reads placements after fleet bring-up). Enacts, per draw:
+
+    - **replica loss mid-stream**: EVERY host of one seeded victim replica
+      is preempted (taint + maintenance notice; NodeLifecycle drains after
+      `grace_s`) — the fleet's unit of failure is a whole gang, and the
+      router must eject it while the controller re-places through the
+      repair/warm-pool paths,
+    - **slow replica**: one surviving replica is named in the plan with a
+      seeded latency factor. The engines live OUTSIDE the cluster sim, so
+      the caller applies the slowdown at its engine boundary (the loadtest
+      wraps submit with the factor) — the router's TTFT-tail scoring and
+      hedging must route around it,
+    - **probe flaps**: a count-bounded cluster-DNS partition on half of one
+      surviving replica's hosts — transient probe failures that must feed
+      the router's breaker WITHOUT permanently ejecting a healthy replica
+      (bounded re-admission earns it back),
+    - plus the usual control-plane schedule (seeded_bad_day).
+
+    Returns the enacted plan {"killed_replica", "preempted": [nodes],
+    "slow_replica", "slow_factor", "probe_flap_hosts": [nodes]} so the soak
+    can heal and assert outcomes."""
+    rng = random.Random(seed)
+    # draw the control-plane seed FIRST, install its rules LAST (the
+    # preemption writes below must not be swallowed by a 429 rule) — the
+    # seeded_slice_bad_day idiom
+    cp_seed = rng.randrange(2**31) if control_plane else None
+    plan: Dict[str, Any] = {
+        "killed_replica": None,
+        "preempted": [],
+        "slow_replica": None,
+        "slow_factor": 1.0,
+        "probe_flap_hosts": [],
+    }
+    indexes = sorted(replica_nodes)
+    if indexes:
+        victim = rng.choice(indexes)
+        plan["killed_replica"] = victim
+        for node in sorted(replica_nodes[victim]):
+            cluster.preempt_node(node, grace_s=grace_s)
+            plan["preempted"].append(node)
+        survivors = [i for i in indexes if i != victim]
+        if survivors:
+            plan["slow_replica"] = rng.choice(survivors)
+            plan["slow_factor"] = round(rng.uniform(*slow_factor_range), 2)
+            flap_hosts = sorted(replica_nodes[rng.choice(survivors)])
+            for node in flap_hosts[: max(1, len(flap_hosts) // 2)]:
+                cluster.faults.partition_probe(
+                    host=node, times=rng.randint(1, 3)
+                )
+                plan["probe_flap_hosts"].append(node)
     if cp_seed is not None:
         seeded_bad_day(cluster.faults, seed=cp_seed)
     return plan

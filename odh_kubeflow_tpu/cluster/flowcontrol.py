@@ -101,6 +101,10 @@ def default_levels() -> List[PriorityLevel]:
         PriorityLevel("system", seats=16, queue_length=64, queue_timeout_s=10.0),
         # interactive + serving reconcilers: the protected class
         PriorityLevel("workload-high", seats=12, queue_length=64, queue_timeout_s=10.0),
+        # data-plane inference requests (serving/router.py holds a seat per
+        # routed generation): a hot endpoint contends HERE — its shed is a
+        # wire 429 from the router — and can never starve the API levels
+        PriorityLevel("serving", seats=8, queue_length=32, queue_timeout_s=5.0),
         # batch admission (TPUJob storms land here): narrow seats, short
         # queue — overload sheds HERE instead of starving the levels above
         PriorityLevel("batch", seats=4, queue_length=8, queue_timeout_s=2.0),
@@ -134,7 +138,17 @@ def default_flow_schemas() -> List[FlowSchema]:
                 "slice-repair",
                 "inference-endpoint",
                 "canary",
+                # ISSUE 16 control plane: the autoscaler's list/patch sweep
+                # and the router's cold-wake patch ride the protected class
+                # — a parked endpoint must wake even under admission storms
+                "endpoint-autoscaler",
+                "token-router",
             ),
+        ),
+        # ISSUE 16 data plane: routed generations (whatever their dynamic
+        # per-endpoint flow name) land in the serving budget by KIND
+        FlowSchema(
+            "serving-requests", "serving", kinds=("InferenceRequest",)
         ),
         FlowSchema("batch-controllers", "batch", flows=("tpu-job",)),
         # unclassified callers creating/deleting TPUJobs (the loadtest driver,

@@ -130,6 +130,16 @@ class Config:
     canary_namespace: str = "slo-canary"
     canary_accelerator: str = ""
     canary_topology: str = ""
+    # SLO-burn replica autoscaler (runtime/autoscaler.py): period 0 disables
+    # and gates the main.py wiring; stabilization/idle are the DEFAULTS an
+    # endpoint's autoscaling spec can override per endpoint
+    autoscale_period_s: float = 0.0
+    autoscale_stabilization_s: float = 30.0
+    autoscale_idle_s: float = 120.0
+    # token router (serving/router.py): consecutive failures before a
+    # replica is ejected, and the tail-hedge trigger (0 disables hedging)
+    router_eject_failures: int = 3
+    router_hedge_after_s: float = 0.0
     # MaxConcurrentReconciles analog: worker threads per controller. The
     # workqueue's per-key single-flight makes >1 safe; under create storms
     # (and over the higher-latency remote transport) it is the difference
@@ -266,6 +276,25 @@ class Config:
             "CANARY_ACCELERATOR", c.canary_accelerator
         )
         c.canary_topology = os.environ.get("CANARY_TOPOLOGY", c.canary_topology)
+        if os.environ.get("AUTOSCALE_PERIOD_S"):
+            c.autoscale_period_s = max(
+                0.0, float(os.environ["AUTOSCALE_PERIOD_S"])
+            )
+        if os.environ.get("AUTOSCALE_STABILIZATION_S"):
+            c.autoscale_stabilization_s = max(
+                0.0, float(os.environ["AUTOSCALE_STABILIZATION_S"])
+            )
+        if os.environ.get("AUTOSCALE_IDLE_S"):
+            c.autoscale_idle_s = max(0.0, float(os.environ["AUTOSCALE_IDLE_S"]))
+        if os.environ.get("ROUTER_EJECT_FAILURES"):
+            # clamp: 0 would eject a replica on its first hiccup forever
+            c.router_eject_failures = max(
+                1, int(os.environ["ROUTER_EJECT_FAILURES"])
+            )
+        if os.environ.get("ROUTER_HEDGE_AFTER_S"):
+            c.router_hedge_after_s = max(
+                0.0, float(os.environ["ROUTER_HEDGE_AFTER_S"])
+            )
         if os.environ.get("MAX_CONCURRENT_RECONCILES"):
             # clamp: 0/negative would spawn no workers and silently disable
             # every controller
@@ -373,6 +402,17 @@ ENV_CONTRACT: tuple = (
             "canary TPU accelerator ('' = CPU canary)"),
     EnvKnob("CANARY_TOPOLOGY", "", "controllers/config.py",
             "canary TPU topology"),
+    EnvKnob("AUTOSCALE_PERIOD_S", "0", "controllers/config.py",
+            "replica-autoscaler sweep period (0 disables; also gates "
+            "main.py wiring)"),
+    EnvKnob("AUTOSCALE_STABILIZATION_S", "30", "controllers/config.py",
+            "default scale-down stabilization window (flap damping)"),
+    EnvKnob("AUTOSCALE_IDLE_S", "120", "controllers/config.py",
+            "default idle window before scale-to-zero parks an endpoint"),
+    EnvKnob("ROUTER_EJECT_FAILURES", "3", "controllers/config.py",
+            "consecutive failures before the router ejects a replica"),
+    EnvKnob("ROUTER_HEDGE_AFTER_S", "0", "controllers/config.py",
+            "router tail-hedge trigger (0 disables hedging)"),
     EnvKnob("MAX_CONCURRENT_RECONCILES", "4", "controllers/config.py",
             "worker threads per controller"),
     # -- manager process wiring (main.py) --

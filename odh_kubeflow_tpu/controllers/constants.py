@@ -114,6 +114,34 @@ INFERENCE_PROMOTED_FROM_ANNOTATION = "inference.tpu.kubeflow.org/promoted-from"
 # pod -> owning InferenceEndpoint (the serving analog of notebook-name: the
 # scheduler's claimed-pool owner check and the sim probe agent both key on it)
 INFERENCE_NAME_LABEL = "inference-endpoint-name"
+# -- serving fleet (ISSUE 16) --
+# pod -> replica index within the endpoint's fleet: readiness is counted PER
+# replica gang (a gang is ready only when all its hosts are), while every pod
+# still carries INFERENCE_NAME_LABEL so the slicepool claim owner stays ns/name
+INFERENCE_REPLICA_LABEL = "inference-endpoint-replica"
+# the autoscaler's output channel (the HPA analog): runtime/autoscaler.py
+# writes the desired replica count HERE, controllers/inference.py clamps it
+# into autoscaling.{min,max} and reconciles toward it — single-writer
+# ownership of INFERENCE_STATE_ANNOTATION stays with the inference controller
+INFERENCE_DESIRED_REPLICAS_ANNOTATION = (
+    "inference.tpu.kubeflow.org/desired-replicas"
+)
+# route-first per-replica drain (scale-down): JSON {"replica": i, "deadline":
+# rfc3339} stamped when the controller picks a scale-down victim; the router
+# stops sending it traffic (status.draining_replicas mirrors it), in-flight
+# requests get the bounded window, then the gang scales away and its slice
+# releases warm. Cleared at retire (or when the scale-down is withdrawn)
+INFERENCE_REPLICA_DRAIN_ANNOTATION = (
+    "inference.tpu.kubeflow.org/replica-draining"
+)
+# scale-to-zero park marker: stamped when the autoscaler parks the fleet
+# (endpoint-state -> suspended, route left up); ANY writer clearing it (the
+# router's cold-wake, an operator) pops the endpoint back to Pending
+INFERENCE_SUSPENDED_AT_ANNOTATION = "inference.tpu.kubeflow.org/suspended-at"
+# status condition while the fleet is degraded (>=1 but < desired replicas
+# healthy): the endpoint keeps Serving — partial capacity is not an outage —
+# but humans and the alert surface see the reduced strength
+DEGRADED_SERVING_CONDITION = "DegradedServing"
 # Serving endpoints default ABOVE interactive notebooks in the reclaim
 # ordering (ISSUE 9 bugfix): a spec.tpu.priority of 0 on an endpoint reads
 # as this value, so an idle notebook is always suspended before live traffic

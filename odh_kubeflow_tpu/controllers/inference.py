@@ -13,13 +13,25 @@ State machine (annotation-durable like suspend/repair; declared as data in
 analysis/machines.py so PR 8's conformance checker and INVCHECK cover it
 from day one):
 
-    Pending ("") ──all hosts ready──> Loading ──verified──> Serving
-         │                               │  window expired /        │ stop
-         │ stop                          │  checksum mismatch       v
-         └────> Draining <───────────────┴──> LoadFailed      Draining
-                   │ drained/deadline          (terminal, self-healing,
-                   v                            incident bundle)
+    Pending ("") ──gang ready──> Loading ──verified──> Serving ⇄ Suspended
+         │                          │  window expired /        │ stop
+         │ stop                     │  checksum mismatch       v
+         └────> Draining <──────────┴──> LoadFailed       Draining
+                   │ drained/deadline     (terminal, self-healing,
+                   v                       incident bundle)
                Terminated (replicas 0; slice released warm)
+
+Serving is FLEET management (ISSUE 16): `spec.serving.replicas` /
+`spec.serving.autoscaling` (or the autoscaler's desired-replicas
+annotation) sets how many independent replica GANGS to run — each its own
+StatefulSet + gang-DNS headless Service + slicepool claim. Scale-up is a
+warm bind from the pool; scale-down is a route-first bounded per-replica
+drain back to the pool (the router stops picking `status.drainingReplicas`
+before the slice releases); desired 0 with `scaleToZero` parks the whole
+endpoint Suspended-with-a-route that cold-wakes when anything bumps
+desired replicas back up. The endpoint stays Serving while >= 1 gang is
+healthy, carrying a DegradedServing condition below full strength; only a
+FULL outage re-enters Loading.
 
 - **Promotion is a warm bind.** With ``spec.notebookRef`` naming a
   just-suspended notebook, Pending claims the source's released slice from
@@ -73,6 +85,7 @@ from ..api.inference import InferenceEndpoint
 from ..api.notebook import Notebook, TPUSpec, TPUStatus
 from ..apimachinery import (
     AlreadyExistsError,
+    Condition,
     NotFoundError,
     parse_time,
     rfc3339_precise,
@@ -99,6 +112,7 @@ STATE_SERVING = "serving"
 STATE_DRAINING = "draining"
 STATE_TERMINATED = "terminated"
 STATE_LOAD_FAILED = "load-failed"
+STATE_SUSPENDED = "suspended"  # scale-to-zero park (ISSUE 16)
 
 INFERENCE_PORT = 8000  # in-pod serving engine HTTP port
 
@@ -178,18 +192,51 @@ def resolve_endpoint_tpu(client, ep: InferenceEndpoint) -> Optional[TPUSpec]:
     return None
 
 
-def endpoint_statefulset_name(name: str) -> str:
+def endpoint_statefulset_name(name: str, replica: int = 0) -> str:
     """`-serve` suffix keeps a promoted endpoint's workload disjoint from a
-    same-named notebook's STS/pods in the same namespace."""
-    return sanitize_name(f"{name}-serve", max_len=52)
+    same-named notebook's STS/pods in the same namespace. Replica 0 keeps
+    the pre-fleet name (upgrades roll nothing); replica i >= 1 appends
+    `-r{i}` — each replica gang is its OWN StatefulSet."""
+    suffix = "-serve" if replica <= 0 else f"-serve-r{replica}"
+    return sanitize_name(f"{name}{suffix}", max_len=52)
 
 
 def endpoint_service_name(name: str) -> str:
     return sanitize_name(f"{name}-serve", max_len=63)
 
 
-def endpoint_hosts_service_name(name: str) -> str:
-    return sanitize_name(f"{name}-serve-hosts", max_len=63)
+def endpoint_hosts_service_name(name: str, replica: int = 0) -> str:
+    suffix = "-serve-hosts" if replica <= 0 else f"-serve-r{replica}-hosts"
+    return sanitize_name(f"{name}{suffix}", max_len=63)
+
+
+def endpoint_desired_replicas(ep: InferenceEndpoint) -> int:
+    """The fleet size the controller converges toward: the autoscaler's
+    desired-replicas annotation when present (the HPA analog — the
+    autoscaler owns that annotation, this controller owns the state
+    machine), else `spec.serving.replicas`, clamped into
+    `spec.serving.autoscaling.{min,max}`. 0 is only reachable with
+    `autoscaling.scaleToZero` — anything else floors at minReplicas."""
+    serving = ep.spec.serving
+    try:
+        static = max(1, int(serving.replicas or 1))
+    except (TypeError, ValueError):
+        static = 1
+    desired = static
+    raw = ep.metadata.annotations.get(C.INFERENCE_DESIRED_REPLICAS_ANNOTATION)
+    if raw is not None:
+        try:
+            desired = int(raw)
+        except (TypeError, ValueError):
+            desired = static
+    auto = serving.autoscaling
+    if auto is None:
+        return max(1, desired)
+    hi = max(1, int(auto.max_replicas))
+    lo = max(1, min(int(auto.min_replicas), hi))
+    if desired <= 0:
+        return 0 if auto.scale_to_zero else lo
+    return min(hi, max(lo, desired))
 
 
 def endpoint_route_name(ep: InferenceEndpoint) -> str:
@@ -273,7 +320,8 @@ class InferenceEndpointReconciler:
 
         if stopped:
             if state in (
-                "", STATE_LOADING, STATE_SERVING, STATE_LOAD_FAILED
+                "", STATE_LOADING, STATE_SERVING, STATE_LOAD_FAILED,
+                STATE_SUSPENDED,
             ):
                 # route down FIRST: no new traffic lands while the drain
                 # window runs; the in-pod engine fails leftovers fast
@@ -288,6 +336,8 @@ class InferenceEndpointReconciler:
                             rfc3339_precise(now + drain_s)
                         ),
                         C.INFERENCE_LOADING_DEADLINE_ANNOTATION: None,
+                        C.INFERENCE_REPLICA_DRAIN_ANNOTATION: None,
+                        C.INFERENCE_SUSPENDED_AT_ANNOTATION: None,
                     },
                 )
                 self._emit_event(
@@ -336,6 +386,32 @@ class InferenceEndpointReconciler:
                 state="pending", from_state=state,
             )
             return Result(requeue_after=0.02)
+        if state == STATE_SUSPENDED:
+            if endpoint_desired_replicas(ep) > 0:
+                # cold-wake: the router's first request (or the autoscaler,
+                # or an operator) bumped desired replicas — a fresh Pending
+                # episode warm-binds from the pool, route already up
+                self._patch_annotations(
+                    ep,
+                    {
+                        C.INFERENCE_STATE_ANNOTATION: None,
+                        C.INFERENCE_SUSPENDED_AT_ANNOTATION: None,
+                    },
+                )
+                self._emit_event(
+                    ep, "EndpointWaking",
+                    "cold-wake from scale-to-zero: desired replicas > 0, "
+                    "re-placing the fleet (warm bind when the pool has the "
+                    "shape)",
+                    etype="Normal",
+                )
+                recorder.record(
+                    "transition", machine="inference", endpoint=req.key,
+                    state="pending", from_state=STATE_SUSPENDED,
+                    reason="cold-wake",
+                )
+                return Result(requeue_after=0.02)
+            return self._hold_suspended(ep, shape)
         if state == "":
             return self._run_pending(ep, shape, now, req)
         if state == STATE_LOADING:
@@ -351,9 +427,10 @@ class InferenceEndpointReconciler:
     def _run_pending(
         self, ep: InferenceEndpoint, shape: SliceShape, now: float, req: Request
     ) -> Result:
+        fleet = max(1, endpoint_desired_replicas(ep))
         self._ensure_promotion(ep, shape, req)
-        self._reconcile_workload(ep, shape, replicas=shape.hosts)
-        self._mirror_status(ep, shape, phase="Pending")
+        self._reconcile_workload(ep, shape, replicas=shape.hosts, fleet=fleet)
+        self._mirror_status(ep, shape, phase="Pending", desired=fleet)
         if self._hosts_ready(ep, shape):
             window = self.config.serving_loading_window_s
             self._patch_annotations(
@@ -482,8 +559,9 @@ class InferenceEndpointReconciler:
     def _run_loading(
         self, ep: InferenceEndpoint, shape: SliceShape, now: float, req: Request
     ) -> Optional[Result]:
-        self._reconcile_workload(ep, shape, replicas=shape.hosts)
-        self._mirror_status(ep, shape, phase="Loading")
+        fleet = max(1, endpoint_desired_replicas(ep))
+        self._reconcile_workload(ep, shape, replicas=shape.hosts, fleet=fleet)
+        self._mirror_status(ep, shape, phase="Loading", desired=fleet)
         deadline_s = ep.metadata.annotations.get(
             C.INFERENCE_LOADING_DEADLINE_ANNOTATION, ""
         )
@@ -492,8 +570,9 @@ class InferenceEndpointReconciler:
         except ValueError:
             deadline = now + self.config.serving_loading_window_s
 
-        if self._hosts_ready(ep, shape) and self._mesh_ready(ep, shape):
-            verdict, detail = self._verify_restore(ep, shape)
+        gang = self._first_ready_gang(ep, shape)
+        if gang is not None and self._mesh_ready(ep, shape, replica=gang):
+            verdict, detail = self._verify_restore(ep, shape, replica=gang)
             if verdict == "mismatch":
                 return self._fail_loading(
                     ep, now, req,
@@ -504,15 +583,16 @@ class InferenceEndpointReconciler:
         if now >= deadline:
             return self._fail_loading(
                 ep, now, req,
-                f"loading window expired before every host reached "
-                f"mesh-ready ({self._ready_count(ep)}/{shape.hosts} ready)",
+                f"loading window expired before any replica gang reached "
+                f"mesh-ready ({self._ready_count(ep)}/{shape.hosts} hosts "
+                f"ready)",
             )
         return Result(requeue_after=max(
             0.02, min(self.config.readiness_probe_period_s / 2, deadline - now)
         ))
 
     def _verify_restore(
-        self, ep: InferenceEndpoint, shape: SliceShape
+        self, ep: InferenceEndpoint, shape: SliceShape, replica: int = 0
     ) -> Tuple[str, str]:
         """Ordinal 0's /tpu/restore checksum vs the saved-checkpoint digest
         inherited at promotion (the digest is ordinal 0's own — per-shard
@@ -521,7 +601,7 @@ class InferenceEndpointReconciler:
         expected = ep.metadata.annotations.get(
             C.TPU_CHECKPOINT_CHECKSUM_ANNOTATION, ""
         )
-        urls = self._probe_urls(ep, shape, "/tpu/restore")
+        urls = self._probe_urls(ep, shape, "/tpu/restore", replica=replica)
         ack = probe_restore_ack(self.http_get, urls[0]) if (
             expected and urls
         ) else None
@@ -546,7 +626,10 @@ class InferenceEndpointReconciler:
             },
         )
         self._ensure_route(ep)
-        self._mirror_status(ep, shape, phase="Serving")
+        self._mirror_status(
+            ep, shape, phase="Serving",
+            desired=max(1, endpoint_desired_replicas(ep)),
+        )
         self._emit_event(
             ep, "EndpointServing",
             "serving: every host mesh-ready, restore "
@@ -590,14 +673,125 @@ class InferenceEndpointReconciler:
 
     def _run_serving(
         self, ep: InferenceEndpoint, shape: SliceShape, now: float, req: Request
-    ) -> Result:
-        self._reconcile_workload(ep, shape, replicas=shape.hosts)
+    ) -> Optional[Result]:
+        """Serving is fleet management (ISSUE 16): converge the replica-gang
+        count toward `endpoint_desired_replicas`, where scale-up is a warm
+        bind from the pool, scale-down is a route-first bounded per-replica
+        drain back to the pool, and desired 0 (scaleToZero) parks the whole
+        endpoint Suspended-with-a-route. The endpoint stays Serving while
+        >= 1 gang is healthy (DegradedServing condition below full
+        strength); only a FULL outage re-enters Loading to re-form."""
+        desired = endpoint_desired_replicas(ep)
+        auto = ep.spec.serving.autoscaling
+        if desired == 0 and auto is not None and auto.scale_to_zero:
+            return self._park_suspended(ep, shape, now, req)
+        desired = max(1, desired)
+
+        drain = self._replica_drain(ep)
+        observed = self._observed_fleet(ep)
+        if drain is not None:
+            victim, deadline = drain
+            if desired > victim:
+                # scale-down withdrawn (burn came back): keep the victim
+                self._patch_annotations(
+                    ep, {C.INFERENCE_REPLICA_DRAIN_ANNOTATION: None}
+                )
+                return Result(requeue_after=0.02)
+            # victim stays up (its STS untouched) but OUT of rotation: the
+            # router reads status.draining_replicas and stops picking it
+            self._reconcile_workload(
+                ep, shape, replicas=shape.hosts, fleet=victim + 1
+            )
+            self._ensure_route(ep)
+            self._mirror_status(
+                ep, shape, phase="Serving", desired=desired, draining=victim
+            )
+            if now >= deadline:
+                self._retire_replica(ep, shape, victim, now, req, desired)
+                return Result(requeue_after=0.02)
+            return Result(requeue_after=max(0.02, min(deadline - now, 1.0)))
+
+        if observed > desired:
+            # route-first: pick the highest gang as victim, open its bounded
+            # drain window; the slice releases warm at retire
+            victim = observed - 1
+            drain_s = ep.spec.serving.drain_timeout_s or \
+                self.config.serving_drain_timeout_s
+            self._patch_annotations(
+                ep,
+                {
+                    C.INFERENCE_REPLICA_DRAIN_ANNOTATION: json.dumps(
+                        {"replica": victim,
+                         "deadline": rfc3339_precise(now + drain_s)}
+                    ),
+                },
+            )
+            self._emit_event(
+                ep, "ReplicaDraining",
+                f"scale-down {observed}->{desired}: replica {victim} out of "
+                f"rotation, in-flight requests get {drain_s:.0f}s before its "
+                "slice releases warm",
+                etype="Normal",
+            )
+            recorder.record(
+                "scale", machine="inference", endpoint=req.key,
+                direction="down", replica=victim, fleet=observed,
+                desired=desired,
+            )
+            return Result(requeue_after=0.02)
+
+        if observed < desired:
+            # scale-up: one warm-bind attempt per missing gang before the
+            # STSs materialize — a pool hit skips the cold placement path
+            warm = 0
+            for _ in range(observed, desired):
+                entry = self.pool.claim(
+                    shape.gke_accelerator, shape.topology, req.key
+                )
+                if entry is not None:
+                    warm += 1
+            self._reconcile_workload(
+                ep, shape, replicas=shape.hosts, fleet=desired
+            )
+            self._ensure_route(ep)
+            self._mirror_status(ep, shape, phase="Serving", desired=desired)
+            self._emit_event(
+                ep, "ReplicaScalingUp",
+                f"scale-up {observed}->{desired}: {warm} warm bind(s), "
+                f"{desired - observed - warm} cold placement(s)",
+                etype="Normal",
+            )
+            recorder.record(
+                "scale", machine="inference", endpoint=req.key,
+                direction="up", fleet=observed, desired=desired, warm=warm,
+            )
+            record_span(
+                "endpoint.scale_up",
+                traceparent=ep.metadata.annotations.get(
+                    C.TRACEPARENT_ANNOTATION
+                ),
+                endpoint=ep.metadata.name,
+                namespace=ep.metadata.namespace,
+                from_replicas=observed,
+                to_replicas=desired,
+                warm_binds=warm,
+            )
+            return Result(requeue_after=0.05)
+
+        self._reconcile_workload(ep, shape, replicas=shape.hosts, fleet=desired)
         self._ensure_route(ep)
-        self._mirror_status(ep, shape, phase="Serving")
-        if not self._hosts_ready(ep, shape):
-            # a host died under us (preemption, crash): back to Loading to
-            # re-verify once the gang re-forms — the repair controller never
-            # touches endpoints, so this edge is the whole recovery story
+        self._mirror_status(ep, shape, phase="Serving", desired=desired)
+        ready_gangs = self._ready_gangs(ep, shape)
+        if len(ready_gangs) >= desired:
+            # full strength: any leftover scale-up claims have served their
+            # bind window (the suspend idiom — pods plainly own the slices)
+            self._release_claims(req.key, back_to_warm=False)
+        if not ready_gangs:
+            # EVERY gang lost readiness (preemption, crash): back to Loading
+            # to re-form and re-verify — the repair controller never touches
+            # endpoints, so this edge is the whole recovery story. A partial
+            # loss stays Serving (DegradedServing condition) and the gang
+            # re-places through the same level-triggered workload reconcile.
             window = self.config.serving_loading_window_s
             self._patch_annotations(
                 ep,
@@ -610,8 +804,8 @@ class InferenceEndpointReconciler:
             )
             self._emit_event(
                 ep, "EndpointDegraded",
-                f"lost host readiness while Serving "
-                f"({self._ready_count(ep)}/{shape.hosts} ready): "
+                f"lost ALL replica readiness while Serving "
+                f"({self._ready_count(ep)} hosts ready across the fleet): "
                 "re-entering Loading to re-form and re-verify",
             )
             recorder.record(
@@ -622,6 +816,153 @@ class InferenceEndpointReconciler:
         return Result(requeue_after=max(
             1.0, self.config.readiness_probe_period_s * 6
         ))
+
+    # ---------- fleet scale-down / scale-to-zero ----------
+
+    def _replica_drain(
+        self, ep: InferenceEndpoint
+    ) -> Optional[Tuple[int, float]]:
+        """(victim index, deadline) of an in-progress per-replica drain."""
+        raw = ep.metadata.annotations.get(
+            C.INFERENCE_REPLICA_DRAIN_ANNOTATION, ""
+        )
+        if not raw:
+            return None
+        try:
+            data = json.loads(raw)
+            return int(data["replica"]), parse_time(data["deadline"]).timestamp()
+        except (ValueError, KeyError, TypeError):
+            return None
+
+    def _retire_replica(
+        self, ep: InferenceEndpoint, shape: SliceShape, victim: int,
+        now: float, req: Request, desired: int,
+    ) -> None:
+        """Drain window over: scale the victim gang away and release its
+        slice back to the warm pool (the suspend idiom — released while the
+        pods terminate, so the next scale-up/promotion is a pool hit).
+        Reconciles to fleet=victim (NOT desired): when several replicas must
+        go, each gets its own drain window — the next reconcile opens the
+        next victim's."""
+        pool_name = self._slice_pool_of(ep, replica=victim)
+        self._reconcile_workload(
+            ep, shape, replicas=shape.hosts, fleet=max(victim, 1)
+        )
+        released = False
+        if pool_name and not ep.metadata.annotations.get(
+            C.TPU_RECLAIM_ANNOTATION
+        ):
+            released = self.pool.release(
+                pool_name, self._pool_nodes(pool_name),
+                priority=endpoint_priority(ep),
+            )
+        self._patch_annotations(
+            ep, {C.INFERENCE_REPLICA_DRAIN_ANNOTATION: None}
+        )
+        self._emit_event(
+            ep, "ReplicaRetired",
+            f"replica {victim} drained and retired"
+            + ("; slice released to the warm pool" if released
+               else "; slice returned to general capacity"),
+            etype="Normal",
+        )
+        recorder.record(
+            "scale", machine="inference", endpoint=req.key,
+            direction="down", replica=victim, released_warm=released,
+            retired=True,
+        )
+        record_span(
+            "endpoint.scale_down",
+            traceparent=ep.metadata.annotations.get(C.TRACEPARENT_ANNOTATION),
+            endpoint=ep.metadata.name,
+            namespace=ep.metadata.namespace,
+            replica=victim,
+            to_replicas=desired,
+            released_warm=released,
+        )
+        log.info("endpoint %s retired replica %d (%s)", req.key, victim,
+                 "released warm" if released else "general capacity")
+
+    def _park_suspended(
+        self, ep: InferenceEndpoint, shape: SliceShape, now: float,
+        req: Request,
+    ) -> Optional[Result]:
+        """Scale-to-zero: every gang scales away, every slice releases warm,
+        the route stays UP — the router's cold-wake (first request bumps
+        desired replicas) pops the endpoint back through Pending without an
+        operator in the loop."""
+        pools = self._fleet_pools(ep)
+        self._reconcile_workload(ep, shape, replicas=0, fleet=0)
+        released = 0
+        if not ep.metadata.annotations.get(C.TPU_RECLAIM_ANNOTATION):
+            for pool_name in pools:
+                if self.pool.release(
+                    pool_name, self._pool_nodes(pool_name),
+                    priority=endpoint_priority(ep),
+                ):
+                    released += 1
+        self._patch_annotations(
+            ep,
+            {
+                C.INFERENCE_STATE_ANNOTATION: STATE_SUSPENDED,
+                C.INFERENCE_SUSPENDED_AT_ANNOTATION: rfc3339_precise(now),
+                C.INFERENCE_REPLICA_DRAIN_ANNOTATION: None,
+            },
+        )
+        self._mirror_status(ep, shape, phase="Suspended", desired=0)
+        self._emit_event(
+            ep, "EndpointSuspended",
+            f"scale-to-zero: fleet parked, {released} slice(s) released "
+            "warm; route stays up for the cold-wake",
+            etype="Normal",
+        )
+        recorder.record(
+            "transition", machine="inference", endpoint=req.key,
+            state=STATE_SUSPENDED, released_warm=released,
+        )
+        record_span(
+            "endpoint.scale_down",
+            traceparent=ep.metadata.annotations.get(C.TRACEPARENT_ANNOTATION),
+            endpoint=ep.metadata.name,
+            namespace=ep.metadata.namespace,
+            to_replicas=0,
+            parked=True,
+            released_warm=released,
+        )
+        log.info("endpoint %s suspended (scale-to-zero, %d slices warm)",
+                 req.key, released)
+        return Result(requeue_after=0.05)
+
+    def _hold_suspended(
+        self, ep: InferenceEndpoint, shape: SliceShape
+    ) -> Result:
+        """Suspended steady state: replicas 0 everywhere, route up, nothing
+        to converge until something bumps desired replicas."""
+        self._reconcile_workload(ep, shape, replicas=0, fleet=0)
+        self._ensure_route(ep)
+        self._mirror_status(ep, shape, phase="Suspended", desired=0)
+        return Result(requeue_after=max(
+            1.0, self.config.readiness_probe_period_s * 6
+        ))
+
+    def _fleet_pools(self, ep: InferenceEndpoint) -> List[str]:
+        """Distinct slice nodepools the fleet's placed pods occupy (one per
+        replica gang — a slice fits exactly one gang)."""
+        from ..api.core import Node
+        from ..tpu import GKE_NODEPOOL_LABEL
+
+        pools: List[str] = []
+        for p in self._pods(ep):
+            if not p.spec.node_name:
+                continue
+            try:
+                node = self.client.get(Node, "", p.spec.node_name)
+            except NotFoundError:
+                continue
+            name = node.metadata.labels.get(GKE_NODEPOOL_LABEL, "")
+            if name and name not in pools:
+                pools.append(name)
+        return pools
 
     # ---------- Draining / Terminated ----------
 
@@ -644,19 +985,22 @@ class InferenceEndpointReconciler:
     def _complete_drain(
         self, ep: InferenceEndpoint, shape: SliceShape, now: float, req: Request
     ) -> Optional[Result]:
-        self._reconcile_workload(ep, shape, replicas=0)
         ann = ep.metadata.annotations
         reclaimed = ann.get(C.TPU_RECLAIM_ANNOTATION, "")
-        pool_name = self._slice_pool_of(ep)
+        pools = self._fleet_pools(ep)  # gather BEFORE the fleet scales away
+        self._reconcile_workload(ep, shape, replicas=0)
         released = False
-        if pool_name and not reclaimed:
+        if pools and not reclaimed:
             # drained endpoints release WARM like suspended notebooks: the
-            # next promotion (or resume) of this shape is a pool hit. A
-            # reclaim-forced drain skips this — the requester needs the chips.
-            released = self.pool.release(
-                pool_name, self._pool_nodes(pool_name),
-                priority=endpoint_priority(ep),
-            )
+            # next promotion (or resume) of this shape is a pool hit — every
+            # replica gang's slice, not just the first. A reclaim-forced
+            # drain skips this — the requester needs the chips.
+            for pool_name in pools:
+                if self.pool.release(
+                    pool_name, self._pool_nodes(pool_name),
+                    priority=endpoint_priority(ep),
+                ):
+                    released = True
         else:
             self._release_claims(req.key, back_to_warm=False)
         self._patch_annotations(
@@ -693,27 +1037,43 @@ class InferenceEndpointReconciler:
     # ---------- workload generation ----------
 
     def generate_statefulset(
-        self, ep: InferenceEndpoint, shape: SliceShape, replicas: int
+        self, ep: InferenceEndpoint, shape: SliceShape, replicas: int,
+        replica: int = 0,
     ) -> StatefulSet:
+        """One replica GANG = one StatefulSet (its own gang-DNS headless
+        service, its own slice): the fleet is N of these, not one STS with
+        N*hosts pods — gang scheduling and per-replica drain both need the
+        gang boundary to be a real object boundary."""
         sts = StatefulSet()
-        sts.metadata.name = endpoint_statefulset_name(ep.metadata.name)
+        sts.metadata.name = endpoint_statefulset_name(
+            ep.metadata.name, replica
+        )
         sts.metadata.namespace = ep.metadata.namespace
-        sts.metadata.labels = {C.INFERENCE_NAME_LABEL: ep.metadata.name}
+        sts.metadata.labels = {
+            C.INFERENCE_NAME_LABEL: ep.metadata.name,
+            C.INFERENCE_REPLICA_LABEL: str(replica),
+        }
         sts.spec.replicas = replicas
         sts.spec.selector.match_labels = {
-            C.INFERENCE_NAME_LABEL: ep.metadata.name
+            C.INFERENCE_NAME_LABEL: ep.metadata.name,
+            C.INFERENCE_REPLICA_LABEL: str(replica),
         }
-        sts.spec.service_name = endpoint_hosts_service_name(ep.metadata.name)
+        sts.spec.service_name = endpoint_hosts_service_name(
+            ep.metadata.name, replica
+        )
         sts.spec.pod_management_policy = "Parallel"
 
         template = sts.spec.template
-        template.metadata.labels = {C.INFERENCE_NAME_LABEL: ep.metadata.name}
+        template.metadata.labels = {
+            C.INFERENCE_NAME_LABEL: ep.metadata.name,
+            C.INFERENCE_REPLICA_LABEL: str(replica),
+        }
         template.metadata.annotations = {}
         traceparent = ep.metadata.annotations.get(C.TRACEPARENT_ANNOTATION)
         if traceparent:
             template.metadata.annotations[C.TRACEPARENT_ANNOTATION] = traceparent
         template.spec = ep.spec.template.spec.deepcopy()
-        self._default_container(ep, template.spec, shape)
+        self._default_container(ep, template.spec, shape, replica)
         template.spec.node_selector.update(shape.node_selector())
         if not any(t.key == TPU_RESOURCE for t in template.spec.tolerations):
             template.spec.tolerations.append(
@@ -724,7 +1084,8 @@ class InferenceEndpointReconciler:
         return sts
 
     def _default_container(
-        self, ep: InferenceEndpoint, podspec, shape: SliceShape
+        self, ep: InferenceEndpoint, podspec, shape: SliceShape,
+        replica: int = 0,
     ) -> None:
         container: Optional[Container] = None
         for c in podspec.containers:
@@ -749,8 +1110,8 @@ class InferenceEndpointReconciler:
         existing = {e.name for e in container.env}
         for ev in tpu_env(
             shape,
-            endpoint_statefulset_name(ep.metadata.name),
-            endpoint_hosts_service_name(ep.metadata.name),
+            endpoint_statefulset_name(ep.metadata.name, replica),
+            endpoint_hosts_service_name(ep.metadata.name, replica),
             ep.metadata.namespace,
             self.config.cluster_domain,
         ):
@@ -780,13 +1141,23 @@ class InferenceEndpointReconciler:
         svc.set_owner(ep)
         return svc
 
-    def generate_hosts_service(self, ep: InferenceEndpoint) -> Service:
+    def generate_hosts_service(
+        self, ep: InferenceEndpoint, replica: int = 0
+    ) -> Service:
         svc = Service()
-        svc.metadata.name = endpoint_hosts_service_name(ep.metadata.name)
+        svc.metadata.name = endpoint_hosts_service_name(
+            ep.metadata.name, replica
+        )
         svc.metadata.namespace = ep.metadata.namespace
-        svc.metadata.labels = {C.INFERENCE_NAME_LABEL: ep.metadata.name}
+        svc.metadata.labels = {
+            C.INFERENCE_NAME_LABEL: ep.metadata.name,
+            C.INFERENCE_REPLICA_LABEL: str(replica),
+        }
         svc.spec.cluster_ip = "None"
-        svc.spec.selector = {C.INFERENCE_NAME_LABEL: ep.metadata.name}
+        svc.spec.selector = {
+            C.INFERENCE_NAME_LABEL: ep.metadata.name,
+            C.INFERENCE_REPLICA_LABEL: str(replica),
+        }
         svc.spec.ports = [
             ServicePort(name="jax-coordinator", port=8476, target_port=8476),
             ServicePort(name="probe", port=self.config.probe_port,
@@ -795,34 +1166,108 @@ class InferenceEndpointReconciler:
         svc.set_owner(ep)
         return svc
 
-    def _reconcile_workload(
-        self, ep: InferenceEndpoint, shape: SliceShape, replicas: int
-    ) -> None:
-        desired = self.generate_statefulset(ep, shape, replicas)
-
-        def attempt():
+    def _replica_statefulsets(
+        self, ep: InferenceEndpoint
+    ) -> Dict[int, StatefulSet]:
+        """Index -> STS over the fleet's StatefulSets (pre-fleet objects
+        without a replica label read as replica 0)."""
+        out: Dict[int, StatefulSet] = {}
+        for sts in self.client.list(
+            StatefulSet,
+            namespace=ep.metadata.namespace,
+            labels={C.INFERENCE_NAME_LABEL: ep.metadata.name},
+        ):
             try:
-                current = self.api_reader.get(
-                    StatefulSet, ep.metadata.namespace, desired.metadata.name
+                idx = int(
+                    sts.metadata.labels.get(C.INFERENCE_REPLICA_LABEL, "0")
                 )
-            except NotFoundError:
-                try:
-                    self.client.create(desired)
-                except AlreadyExistsError:
-                    pass  # racing reconcile won; level-triggered convergence
-                return
-            changed = False
-            if current.spec.replicas != desired.spec.replicas:
-                current.spec.replicas = desired.spec.replicas
-                changed = True
-            if current.spec.template.to_dict() != desired.spec.template.to_dict():
-                current.spec.template = desired.spec.template
-                changed = True
-            if changed:
-                self.client.update(current)
+            except (TypeError, ValueError):
+                idx = 0
+            out[idx] = sts
+        return out
 
-        retry_on_conflict(attempt)
-        for svc in (self.generate_service(ep), self.generate_hosts_service(ep)):
+    def _observed_fleet(self, ep: InferenceEndpoint) -> int:
+        """The fleet size the cluster currently expresses: highest replica
+        index with a scaled-up STS, plus one (0 = everything parked)."""
+        active = [
+            i for i, sts in self._replica_statefulsets(ep).items()
+            if (sts.spec.replicas or 0) > 0
+        ]
+        return max(active) + 1 if active else 0
+
+    def _reconcile_workload(
+        self, ep: InferenceEndpoint, shape: SliceShape, replicas: int,
+        fleet: int = 1,
+    ) -> None:
+        """Converge the whole fleet: ensure STS + gang-DNS service for each
+        replica index < fleet (each at `replicas` pods — 0 parks the gang),
+        and GC indexes >= fleet (scale to 0 first, delete once their pods
+        are gone). Replica 0's objects always exist — they hold the
+        pre-fleet names, so a parked endpoint still reads as 'this workload,
+        scaled to zero' rather than vanishing."""
+        fleet = max(1, fleet)
+        existing = self._replica_statefulsets(ep)
+        for idx in range(fleet):
+            desired = self.generate_statefulset(ep, shape, replicas, idx)
+
+            def attempt(desired=desired):
+                try:
+                    current = self.api_reader.get(
+                        StatefulSet, ep.metadata.namespace,
+                        desired.metadata.name,
+                    )
+                except NotFoundError:
+                    try:
+                        self.client.create(desired)
+                    except AlreadyExistsError:
+                        pass  # racing reconcile won; level-triggered
+                    return
+                changed = False
+                if current.spec.replicas != desired.spec.replicas:
+                    current.spec.replicas = desired.spec.replicas
+                    changed = True
+                if current.spec.template.to_dict() != \
+                        desired.spec.template.to_dict():
+                    current.spec.template = desired.spec.template
+                    changed = True
+                if changed:
+                    self.client.update(current)
+
+            retry_on_conflict(attempt)
+        # GC retired replica gangs: scale away first (pods drain through
+        # normal termination), delete the shells once empty
+        for idx, sts in sorted(existing.items()):
+            if idx < fleet:
+                continue
+            if (sts.spec.replicas or 0) > 0:
+                def scale_down(sts=sts):
+                    try:
+                        current = self.api_reader.get(
+                            StatefulSet, ep.metadata.namespace,
+                            sts.metadata.name,
+                        )
+                    except NotFoundError:
+                        return
+                    if (current.spec.replicas or 0) != 0:
+                        current.spec.replicas = 0
+                        self.client.update(current)
+
+                retry_on_conflict(scale_down)
+            elif not self._pods(ep, replica=idx):
+                for kind, name in (
+                    (StatefulSet, sts.metadata.name),
+                    (Service,
+                     endpoint_hosts_service_name(ep.metadata.name, idx)),
+                ):
+                    try:
+                        self.client.delete(kind, ep.metadata.namespace, name)
+                    except NotFoundError:
+                        pass
+        services = [self.generate_service(ep)]
+        services.extend(
+            self.generate_hosts_service(ep, idx) for idx in range(fleet)
+        )
+        for svc in services:
             try:
                 self.client.get(Service, ep.metadata.namespace,
                                 svc.metadata.name)
@@ -880,8 +1325,10 @@ class InferenceEndpointReconciler:
 
     # ---------- readiness ----------
 
-    def _pods(self, ep: InferenceEndpoint) -> List[Pod]:
-        return [
+    def _pods(
+        self, ep: InferenceEndpoint, replica: Optional[int] = None
+    ) -> List[Pod]:
+        pods = [
             p
             for p in self.client.list(
                 Pod,
@@ -890,29 +1337,70 @@ class InferenceEndpointReconciler:
             )
             if not p.metadata.deletion_timestamp
         ]
+        if replica is None:
+            return pods
+        return [
+            p for p in pods
+            if p.metadata.labels.get(C.INFERENCE_REPLICA_LABEL, "0")
+            == str(replica)
+        ]
 
     def _ready_count(self, ep: InferenceEndpoint) -> int:
         return sum(1 for p in self._pods(ep) if p.is_ready())
 
+    def _gang_ready_counts(self, ep: InferenceEndpoint) -> Dict[int, int]:
+        """Ready-pod count per replica gang (missing label = replica 0)."""
+        counts: Dict[int, int] = {}
+        for p in self._pods(ep):
+            if not p.is_ready():
+                continue
+            try:
+                idx = int(p.metadata.labels.get(C.INFERENCE_REPLICA_LABEL, "0"))
+            except (TypeError, ValueError):
+                idx = 0
+            counts[idx] = counts.get(idx, 0) + 1
+        return counts
+
+    def _ready_gangs(
+        self, ep: InferenceEndpoint, shape: SliceShape
+    ) -> List[int]:
+        """Replica indexes whose FULL gang is pod-ready — the fleet's unit
+        of health (a gang missing one host serves nothing)."""
+        return sorted(
+            idx
+            for idx, count in self._gang_ready_counts(ep).items()
+            if count >= shape.hosts
+        )
+
+    def _first_ready_gang(
+        self, ep: InferenceEndpoint, shape: SliceShape
+    ) -> Optional[int]:
+        gangs = self._ready_gangs(ep, shape)
+        return gangs[0] if gangs else None
+
     def _hosts_ready(self, ep: InferenceEndpoint, shape: SliceShape) -> bool:
-        return self._ready_count(ep) >= shape.hosts
+        return bool(self._ready_gangs(ep, shape))
 
     def _probe_urls(
-        self, ep: InferenceEndpoint, shape: SliceShape, path: str
+        self, ep: InferenceEndpoint, shape: SliceShape, path: str,
+        replica: int = 0,
     ) -> List[str]:
-        sts_name = endpoint_statefulset_name(ep.metadata.name)
-        svc = endpoint_hosts_service_name(ep.metadata.name)
+        sts_name = endpoint_statefulset_name(ep.metadata.name, replica)
+        svc = endpoint_hosts_service_name(ep.metadata.name, replica)
         return [
             f"http://{sts_name}-{i}.{svc}.{ep.metadata.namespace}.svc."
             f"{self.config.cluster_domain}:{self.config.probe_port}{path}"
             for i in range(shape.hosts)
         ]
 
-    def _mesh_ready(self, ep: InferenceEndpoint, shape: SliceShape) -> bool:
+    def _mesh_ready(
+        self, ep: InferenceEndpoint, shape: SliceShape, replica: int = 0
+    ) -> bool:
         """Every host's agent reports the full device view (the notebook
         probe gate's contract, driven inline — pod-Ready alone must not
         flip an endpoint to Serving)."""
-        for url in self._probe_urls(ep, shape, "/tpu/readiness"):
+        for url in self._probe_urls(ep, shape, "/tpu/readiness",
+                                    replica=replica):
             try:
                 try:
                     status, body = self.http_get(url, timeout=2.0)
@@ -931,13 +1419,21 @@ class InferenceEndpointReconciler:
     # ---------- status / helpers ----------
 
     def _mirror_status(
-        self, ep: InferenceEndpoint, shape: SliceShape, phase: str
+        self, ep: InferenceEndpoint, shape: SliceShape, phase: str,
+        desired: Optional[int] = None, draining: Optional[int] = None,
     ) -> None:
         ready = self._ready_count(ep)
+        gangs = self._ready_gangs(ep, shape)
         before = ep.status.to_dict()
         status = ep.status
         status.phase = phase
         status.ready_replicas = ready
+        # fleet view (ISSUE 16): the router reads these — servingReplicas is
+        # how many full gangs can take traffic, drainingReplicas which gangs
+        # it must stop picking (route-first drain)
+        status.replicas = desired if desired is not None else 0
+        status.serving_replicas = len(gangs)
+        status.draining_replicas = [draining] if draining is not None else []
         status.tpu = status.tpu or TPUStatus()
         status.tpu.accelerator = shape.accelerator
         status.tpu.topology = shape.topology
@@ -946,7 +1442,11 @@ class InferenceEndpointReconciler:
         status.tpu.chips_per_host = shape.chips_per_host
         status.tpu.chips_expected = shape.chips
         status.tpu.mesh_ready = phase == "Serving"
-        status.url = self._route_path(ep) if phase == "Serving" else ""
+        # Suspended keeps the url: the route IS up, it just cold-wakes
+        status.url = self._route_path(ep) if phase in (
+            "Serving", "Suspended"
+        ) else ""
+        self._upsert_degraded_condition(status, phase, len(gangs), desired)
         if status.to_dict() == before:
             return
         spatch = status.to_dict()
@@ -968,11 +1468,54 @@ class InferenceEndpointReconciler:
         except NotFoundError:
             pass  # deleted mid-reconcile
 
-    def _slice_pool_of(self, ep: InferenceEndpoint) -> str:
+    def _upsert_degraded_condition(
+        self, status, phase: str, gangs_ready: int, desired: Optional[int],
+    ) -> None:
+        """DegradedServing = Serving below full fleet strength but above
+        zero (a full outage re-enters Loading instead). Upsert preserves
+        lastTransitionTime across unchanged statuses so alert/debug tooling
+        sees when degradation STARTED, not the latest probe."""
+        want = max(1, desired or 1)
+        degraded = phase == "Serving" and 0 < gangs_ready < want
+        now_s = rfc3339_precise(time.time())
+        new_status = "True" if degraded else "False"
+        reason = "ReplicaGangsDown" if degraded else "FleetAtStrength"
+        message = (
+            f"{gangs_ready}/{want} replica gangs healthy: serving degraded "
+            "until the lost gangs re-place" if degraded
+            else f"{gangs_ready}/{want} replica gangs healthy"
+        )
+        for cond in status.conditions:
+            if cond.type == C.DEGRADED_SERVING_CONDITION:
+                if (cond.status, cond.reason, cond.message) == (
+                    new_status, reason, message
+                ):
+                    return  # unchanged: keep timestamps so status no-ops
+                if cond.status != new_status:
+                    cond.last_transition_time = now_s
+                cond.status = new_status
+                cond.reason = reason
+                cond.message = message
+                cond.last_probe_time = now_s
+                return
+        status.conditions.append(
+            Condition(
+                type=C.DEGRADED_SERVING_CONDITION,
+                status=new_status,
+                reason=reason,
+                message=message,
+                last_probe_time=now_s,
+                last_transition_time=now_s,
+            )
+        )
+
+    def _slice_pool_of(
+        self, ep: InferenceEndpoint, replica: Optional[int] = None
+    ) -> str:
         from ..api.core import Node
         from ..tpu import GKE_NODEPOOL_LABEL
 
-        for p in self._pods(ep):
+        for p in self._pods(ep, replica=replica):
             if not p.spec.node_name:
                 continue
             try:
@@ -1072,6 +1615,8 @@ class InferenceEndpointReconciler:
 
 __all__ = [
     "InferenceEndpointReconciler",
+    "endpoint_desired_replicas",
+    "endpoint_hosts_service_name",
     "endpoint_priority",
     "endpoint_route_name",
     "endpoint_service_name",

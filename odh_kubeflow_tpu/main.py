@@ -150,6 +150,17 @@ def _wire_observability(mgr: Manager, config: Config) -> None:
         )
         mgr.prober = prober
         mgr.add_service(prober)
+    if config.autoscale_period_s > 0:
+        from .runtime.autoscaler import ReplicaAutoscaler
+
+        autoscaler = ReplicaAutoscaler(
+            mgr,
+            period_s=config.autoscale_period_s,
+            stabilization_s=config.autoscale_stabilization_s,
+            idle_s=config.autoscale_idle_s,
+        )
+        mgr.autoscaler = autoscaler
+        mgr.add_service(autoscaler)
 
 
 def serve_webhook(client, config: Config, cert_dir: str, port: int = 8443):

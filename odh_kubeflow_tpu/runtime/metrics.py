@@ -343,6 +343,20 @@ flowcontrol_wait_seconds = global_registry.histogram(
     buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30, 60),
 )
 
+# ---- SLO-burn replica autoscaler (ISSUE 16, runtime/autoscaler.py) ----
+autoscaler_decisions_total = global_registry.counter(
+    "autoscaler_decisions_total",
+    "Autoscaler decisions per tick per endpoint: up (burn/queue pressure), "
+    "down (stabilized below half target), park (scale-to-zero idle), hold",
+    labels=("action",),
+)
+endpoint_desired_replicas_gauge = global_registry.gauge(
+    "inference_endpoint_desired_replicas",
+    "Fleet size the autoscaler currently wants per endpoint (the "
+    "desired-replicas annotation the endpoint controller converges toward)",
+    labels=("endpoint",),
+)
+
 # ---- controller-runtime-standard telemetry (ISSUE 2): the workqueue /
 # reconcile / informer series every controller dashboard expects, emitted by
 # runtime/workqueue.py, runtime/controller.py and runtime/informer.py ----

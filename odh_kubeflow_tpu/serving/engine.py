@@ -78,6 +78,9 @@ class RequestHandle:
     result: str = ""  # ok | canceled
     ttft_s: Optional[float] = None
     _last_token_t: Optional[float] = None
+    # hedge duplicate whose twin already completed: its cancellation is
+    # bookkeeping, not a user-visible outcome
+    superseded: bool = False
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self.done.wait(timeout)
@@ -310,6 +313,31 @@ class ServingEngine:
         self._work.set()
         return handle
 
+    def cancel(self, handle: RequestHandle) -> bool:
+        """Cancel one in-flight request (the router's hedging path: the
+        losing request of a hedged pair is canceled, not served twice).
+        Queued requests leave the queue; an active slot is recycled so the
+        next admission reuses it. Returns False when the request already
+        completed — the caller keeps that result."""
+        if handle.done.is_set():
+            return False
+        with self._lock:
+            if handle.done.is_set():
+                return False
+            try:
+                self._queue.remove(handle)
+                M.inference_queue_depth.set(float(len(self._queue)))
+            except ValueError:
+                for j, active in enumerate(self._slots):
+                    if active is handle:
+                        self._slots[j] = None  # recycled like EOS
+                        break
+                else:
+                    return False  # completed in the race window
+        self._complete(handle, "canceled", self.clock())
+        self._publish_gauges()
+        return True
+
     # ---------- the engine iteration ----------
 
     def step(self) -> bool:
@@ -451,7 +479,13 @@ class ServingEngine:
     def _complete(self, handle: RequestHandle, result: str,
                   now: float) -> None:
         handle.result = result
-        M.inference_requests_total.inc(result=result)
+        # a superseded handle is a hedge DUPLICATE of a request the winning
+        # replica already counted — billing its cancellation to
+        # inference_requests_total would make every hedge burn the
+        # serving-availability budget (drain/stop cancellations still count:
+        # those are user-visible failures)
+        if not handle.superseded:
+            M.inference_requests_total.inc(result=result)
         record_span(
             "inference.request",
             traceparent=handle.traceparent,

@@ -64,3 +64,41 @@ inference_restore_verifications_total = global_registry.counter(
     "mismatch / unverified)",
     labels=("result",),
 )
+
+# ---- token router (ISSUE 16, serving/router.py): the fleet's data-plane
+# health story. picks_total{result} is the router-level availability ratio
+# (ok vs shed/error/no_replica); added-latency is the routing overhead the
+# bench ledger headlines as router_added_latency_p50_ms.
+inference_router_picks_total = global_registry.counter(
+    "inference_router_picks_total",
+    "Routed generations by terminal outcome: ok (served), shed (admission "
+    "or retry budget -> wire 429), error (retry budget exhausted on "
+    "failures), no_replica (fleet parked/ejected — the cold-wake signal)",
+    labels=("result",),
+)
+inference_router_retries_total = global_registry.counter(
+    "inference_router_retries_total",
+    "Cross-replica retries by trigger: queue_full (replica shed, tried "
+    "another), error (submit raised), canceled (request died mid-flight on "
+    "a torn-down replica)",
+    labels=("reason",),
+)
+inference_router_hedges_total = global_registry.counter(
+    "inference_router_hedges_total",
+    "Tail-latency hedges: launched (second submit fired), primary_won / "
+    "hedge_won (which completion counted; the loser is canceled)",
+    labels=("outcome",),
+)
+inference_router_ejections_total = global_registry.counter(
+    "inference_router_ejections_total",
+    "Replica rotation changes: eject (breaker opened on probe/error "
+    "breach), readmit (half-open trial succeeded)",
+    labels=("action",),
+)
+inference_router_added_latency_seconds = global_registry.histogram(
+    "inference_router_added_latency_seconds",
+    "Router-added latency per request: generate() entry -> accepted engine "
+    "submit (pick scoring + admission + any cross-replica retries)",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1.0),
+)

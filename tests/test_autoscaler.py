@@ -1,0 +1,280 @@
+"""SLO-burn replica autoscaler (runtime/autoscaler.py, ISSUE 16): the pure
+`decide()` policy — burn/queue-pressure scale-up, the scale-down
+stabilization window as a flap damper, the minReplicas floor, scale-to-zero
+parking after a genuine idle window — and the ReplicaAutoscaler sweep that
+writes ONLY the desired-replicas annotation (the endpoint controller owns
+every actual transition).
+
+Deterministic tier-1 tests (marker: autoscaler); the ci/faults.sh router
+lane reruns these with the router tests.
+"""
+from types import SimpleNamespace
+
+import pytest
+
+from odh_kubeflow_tpu.api.inference import (
+    AutoscalingSpec,
+    InferenceEndpoint,
+    ServingSpec,
+)
+from odh_kubeflow_tpu.cluster import Client, Store
+from odh_kubeflow_tpu.controllers import constants as C
+from odh_kubeflow_tpu.controllers.inference import endpoint_desired_replicas
+from odh_kubeflow_tpu.runtime import metrics as rm
+from odh_kubeflow_tpu.runtime.autoscaler import (
+    EndpointScaleState,
+    ReplicaAutoscaler,
+    decide,
+)
+
+pytestmark = pytest.mark.autoscaler
+
+NS = "autoscale"
+
+
+def auto(min_r=1, max_r=4, target=2.0, to_zero=False, stab=30.0, idle=120.0):
+    return AutoscalingSpec(
+        min_replicas=min_r, max_replicas=max_r, target_burn_rate=target,
+        scale_to_zero=to_zero, scale_down_stabilization_s=stab,
+        scale_to_zero_idle_s=idle,
+    )
+
+
+def sig(burn=0.0, queued=0.0, occupancy=0.0):
+    return {"burn_rate": burn, "queue_depth": queued,
+            "slot_occupancy": occupancy}
+
+
+# ---------------------------------------------------------------------------
+# decide(): the pure policy
+# ---------------------------------------------------------------------------
+
+
+def test_scale_up_on_burn_one_replica_per_tick():
+    state = EndpointScaleState()
+    assert decide(1, auto(), sig(burn=3.0), 0.0, state) == (2, "up")
+    assert decide(2, auto(), sig(burn=3.0), 5.0, state) == (3, "up")
+
+
+def test_scale_up_on_queue_pressure_without_burn():
+    state = EndpointScaleState()
+    assert decide(1, auto(), sig(queued=10.0), 0.0, state) == (2, "up")
+    # below the pressure threshold and below target burn: hold
+    assert decide(2, auto(), sig(burn=1.5, queued=3.0), 5.0,
+                  EndpointScaleState()) == (2, "hold")
+
+
+def test_scale_up_capped_at_max_replicas():
+    state = EndpointScaleState()
+    assert decide(4, auto(max_r=4), sig(burn=9.0), 0.0, state) == (4, "hold")
+
+
+def test_min_replicas_floor_holds_under_sustained_low_burn():
+    a = auto(min_r=2, max_r=4, stab=30.0)
+    state = EndpointScaleState()
+    now = 0.0
+    for _ in range(20):  # hours of quiet, many stabilization windows
+        desired, action = decide(2, a, sig(burn=0.0), now, state)
+        assert (desired, action) == (2, "hold")
+        now += 60.0
+
+
+def test_scale_down_waits_for_the_stabilization_window():
+    a = auto(stab=30.0)
+    state = EndpointScaleState()
+    assert decide(3, a, sig(burn=0.1), 0.0, state) == (3, "hold")
+    assert decide(3, a, sig(burn=0.1), 29.0, state) == (3, "hold")
+    assert decide(3, a, sig(burn=0.1), 31.0, state) == (2, "down")
+    # one step per window: the window restarts at the down decision
+    assert decide(2, a, sig(burn=0.1), 32.0, state) == (2, "hold")
+    assert decide(2, a, sig(burn=0.1), 62.0, state) == (1, "down")
+
+
+def test_hot_tick_resets_the_stabilization_window_flap_damped():
+    a = auto(stab=30.0)
+    state = EndpointScaleState()
+    decide(3, a, sig(burn=0.1), 0.0, state)
+    # a burn spike mid-window resets the damper (and scales up)
+    assert decide(3, a, sig(burn=5.0), 20.0, state) == (4, "up")
+    # low again: the 30s clock restarts from here, not from t=0
+    assert decide(4, a, sig(burn=0.1), 40.0, state) == (4, "hold")
+    assert decide(4, a, sig(burn=0.1), 69.0, state) == (4, "hold")
+    assert decide(4, a, sig(burn=0.1), 71.0, state) == (3, "down")
+
+
+def test_hysteresis_band_between_half_and_full_target_holds():
+    a = auto(target=2.0, stab=10.0)
+    state = EndpointScaleState()
+    # burn 1.5 is below target (no up) but above target/2 (no down window)
+    for now in (0.0, 20.0, 40.0):
+        assert decide(3, a, sig(burn=1.5), now, state) == (3, "hold")
+    assert state.below_since is None
+
+
+def test_park_to_zero_only_after_the_idle_window():
+    a = auto(to_zero=True, idle=120.0)
+    state = EndpointScaleState()
+    assert decide(1, a, sig(), 0.0, state) == (1, "hold")
+    assert decide(1, a, sig(), 119.0, state) == (1, "hold")
+    assert decide(1, a, sig(), 121.0, state) == (0, "park")
+    # already parked: stays parked, no thrash
+    assert decide(0, a, sig(), 200.0, state)[0] == 0
+
+
+def test_no_park_without_scale_to_zero():
+    a = auto(to_zero=False, idle=120.0)
+    state = EndpointScaleState()
+    for now in (0.0, 500.0, 5000.0):
+        desired, action = decide(1, a, sig(), now, state)
+        assert desired == 1 and action != "park"
+
+
+def test_inflight_work_resets_the_idle_window():
+    a = auto(to_zero=True, idle=100.0)
+    state = EndpointScaleState()
+    decide(1, a, sig(), 0.0, state)
+    # a single queued request at t=90 means the endpoint is NOT idle
+    decide(1, a, sig(queued=1.0), 90.0, state)
+    assert state.idle_since is None
+    assert decide(1, a, sig(), 150.0, state) == (1, "hold")
+    assert decide(1, a, sig(), 251.0, state) == (0, "park")
+
+
+def test_cold_wake_scales_a_parked_fleet_back_up():
+    a = auto(min_r=2, to_zero=True)
+    state = EndpointScaleState()
+    desired, action = decide(0, a, sig(burn=3.0), 0.0, state)
+    assert (desired, action) == (2, "up")  # straight to the floor
+
+
+# ---------------------------------------------------------------------------
+# ReplicaAutoscaler: the sweep writes only the annotation
+# ---------------------------------------------------------------------------
+
+
+def mk_ep(name, autoscaling=None, replicas=1):
+    ep = InferenceEndpoint()
+    ep.metadata.name = name
+    ep.metadata.namespace = NS
+    ep.spec.serving = ServingSpec(replicas=replicas, autoscaling=autoscaling)
+    return ep
+
+
+def mk_autoscaler(client, signals, clock, **kw):
+    mgr = SimpleNamespace(client=client)
+    return ReplicaAutoscaler(
+        mgr, period_s=999.0, signals_fn=lambda ep: dict(signals),
+        clock=lambda: clock[0], **kw,
+    )
+
+
+def test_tick_patches_only_the_desired_replicas_annotation():
+    store = Store()
+    client = Client(store)
+    client.create(mk_ep("burning", autoscaling=auto(max_r=3)))
+    signals = sig(burn=5.0)
+    clock = [0.0]
+    scaler = mk_autoscaler(client, signals, clock)
+    up0 = rm.autoscaler_decisions_total.value(action="up")
+
+    scaler.tick()
+    ep = client.get(InferenceEndpoint, NS, "burning")
+    assert ep.metadata.annotations[C.INFERENCE_DESIRED_REPLICAS_ANNOTATION] == "2"
+    assert endpoint_desired_replicas(ep) == 2
+    # the autoscaler never touches the state machine or the spec
+    assert C.INFERENCE_STATE_ANNOTATION not in ep.metadata.annotations
+    assert ep.spec.serving.replicas == 1
+    assert rm.autoscaler_decisions_total.value(action="up") == up0 + 1
+    assert rm.endpoint_desired_replicas_gauge.value(
+        endpoint=f"{NS}/burning") == 2.0
+
+    scaler.tick()  # still burning: one more replica, up to the cap
+    ep = client.get(InferenceEndpoint, NS, "burning")
+    assert endpoint_desired_replicas(ep) == 3
+    scaler.tick()
+    assert endpoint_desired_replicas(
+        client.get(InferenceEndpoint, NS, "burning")) == 3  # capped
+
+
+def test_tick_parks_idle_scale_to_zero_endpoint_after_window():
+    store = Store()
+    client = Client(store)
+    client.create(mk_ep("nightly", autoscaling=auto(to_zero=True, idle=60.0)))
+    signals = sig()
+    clock = [0.0]
+    scaler = mk_autoscaler(client, signals, clock)
+    scaler.tick()
+    assert endpoint_desired_replicas(
+        client.get(InferenceEndpoint, NS, "nightly")) == 1
+    clock[0] = 61.0
+    scaler.tick()
+    ep = client.get(InferenceEndpoint, NS, "nightly")
+    assert ep.metadata.annotations[C.INFERENCE_DESIRED_REPLICAS_ANNOTATION] == "0"
+    assert endpoint_desired_replicas(ep) == 0
+
+
+def test_tick_skips_static_and_stopped_endpoints():
+    store = Store()
+    client = Client(store)
+    client.create(mk_ep("static", autoscaling=None, replicas=2))
+    stopped = mk_ep("stopped", autoscaling=auto())
+    stopped.metadata.annotations[C.STOP_ANNOTATION] = "true"
+    client.create(stopped)
+    scaler = mk_autoscaler(client, sig(burn=9.0), [0.0])
+    scaler.tick()
+    for name in ("static", "stopped"):
+        ep = client.get(InferenceEndpoint, NS, name)
+        assert C.INFERENCE_DESIRED_REPLICAS_ANNOTATION not in \
+            ep.metadata.annotations, name
+
+
+def test_state_gc_for_deleted_endpoints():
+    store = Store()
+    client = Client(store)
+    client.create(mk_ep("ghost", autoscaling=auto()))
+    scaler = mk_autoscaler(client, sig(burn=0.1), [0.0])
+    scaler.tick()
+    assert f"{NS}/ghost" in scaler._states
+    client.delete(InferenceEndpoint, NS, "ghost")
+    scaler.tick()
+    assert scaler._states == {}
+
+
+def test_default_signals_read_serving_slos_fast_window_and_engine_gauges():
+    class FakeSLOEngine:
+        windows = {"fast": 300.0, "slow": 3600.0}
+
+        def status(self):
+            return {"slos": {
+                "token-latency": {
+                    "category": "serving",
+                    "windows": {"fast": {"burn_rate": 4.5},
+                                "slow": {"burn_rate": 0.2}},
+                },
+                "notebook-readiness": {  # wrong category: ignored
+                    "category": "workload",
+                    "windows": {"fast": {"burn_rate": 99.0}},
+                },
+            }}
+
+    rm.global_registry.get("inference_queue_depth").set(3.0)
+    rm.global_registry.get("inference_slot_occupancy_ratio").set(0.5)
+    mgr = SimpleNamespace(
+        client=None, slo_engine=FakeSLOEngine(), metrics=rm.global_registry,
+    )
+    scaler = ReplicaAutoscaler(mgr, period_s=999.0)
+    signals = scaler._default_signals(mk_ep("any"))
+    assert signals == {"burn_rate": 4.5, "queue_depth": 3.0,
+                       "slot_occupancy": 0.5}
+    rm.global_registry.get("inference_queue_depth").set(0.0)
+    rm.global_registry.get("inference_slot_occupancy_ratio").set(0.0)
+
+
+def test_service_lifecycle_start_stop():
+    store = Store()
+    client = Client(store)
+    scaler = mk_autoscaler(client, sig(), [0.0])
+    scaler.start()
+    assert scaler._thread is not None and scaler._thread.is_alive()
+    scaler.stop()
+    assert scaler._thread is None

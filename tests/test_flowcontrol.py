@@ -314,7 +314,9 @@ def test_debug_flowcontrol_view():
         ) as resp:
             payload = json.loads(resp.read().decode())
         levels = payload["levels"]
-        assert set(levels) == {"exempt", "system", "workload-high", "batch", "default"}
+        assert set(levels) == {
+            "exempt", "system", "workload-high", "serving", "batch", "default"
+        }
         assert levels["batch"]["dispatched"] >= 1
         assert levels["exempt"]["exempt"] is True
         # the index page links the view

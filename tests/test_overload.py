@@ -70,6 +70,8 @@ def storm_flowcontrol():
             PriorityLevel("system", seats=16, queue_length=64, queue_timeout_s=10.0),
             PriorityLevel("workload-high", seats=12, queue_length=64,
                           queue_timeout_s=10.0),
+            PriorityLevel("serving", seats=8, queue_length=32,
+                          queue_timeout_s=5.0),
             PriorityLevel("batch", seats=2, queue_length=2, queue_timeout_s=0.2),
             PriorityLevel("default", seats=8, queue_length=32, queue_timeout_s=5.0),
         ],

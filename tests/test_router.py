@@ -1,0 +1,486 @@
+"""Health-aware token router (serving/router.py, ISSUE 16): signal-driven
+picking, breaker ejection with bounded re-admission, cross-replica retries
+for idempotent failures, tail hedging that cancels the loser, route-first
+drain, cold-wake on a parked fleet, and the serving priority-level 429.
+
+The router is duck-typed over engine-like backends (submit/stats/cancel is
+the whole contract), so these tests drive it with scripted fakes — the
+loadtest multi-replica tier exercises the same router against the real
+ServingEngine. Deterministic tier-1 tests (marker: router); the
+ci/faults.sh router lane reruns these under REPEAT + RACECHECK=1 +
+INVCHECK=1 + DEPLOYGUARD=1.
+"""
+import random
+import threading
+import time
+
+import pytest
+
+from odh_kubeflow_tpu.cluster.flowcontrol import (
+    FlowController,
+    FlowSchema,
+    PriorityLevel,
+    current_flow,
+)
+from odh_kubeflow_tpu.serving import metrics as sm
+from odh_kubeflow_tpu.serving.engine import QueueFull, RequestHandle
+from odh_kubeflow_tpu.serving.router import RouteResult, TokenRouter
+
+pytestmark = pytest.mark.router
+
+
+class FakeEngine:
+    """Engine-like backend with scripted behavior. mode:
+    ok         — submit returns an already-completed handle
+    hang       — submit returns an open handle (complete via .complete())
+    error      — submit raises ConnectionError
+    queue_full — submit raises QueueFull
+    canceled   — submit returns a handle already completed `canceled`
+    """
+
+    def __init__(self, mode="ok", queued=0, active=0, slots=4, ttft=0.0):
+        self.mode = mode
+        self.queued = queued
+        self.active = active
+        self.slots = slots
+        self.ttft = ttft
+        self.submitted = []
+        self.canceled = []
+        self._n = 0
+
+    def stats(self):
+        return {
+            "queued": self.queued,
+            "active_slots": self.active,
+            "max_slots": self.slots,
+        }
+
+    def submit(self, prompt, max_new, traceparent=None):
+        if self.mode == "error":
+            raise ConnectionError("replica down")
+        if self.mode == "queue_full":
+            raise QueueFull("admission queue full")
+        self._n += 1
+        h = RequestHandle(
+            id=self._n, prompt=list(prompt), max_new=max_new,
+            submitted=time.monotonic(), traceparent=traceparent,
+        )
+        self.submitted.append(h)
+        if self.mode == "ok":
+            self.complete(h, "ok")
+        elif self.mode == "canceled":
+            self.complete(h, "canceled")
+        return h
+
+    def complete(self, h, result="ok"):
+        if result == "ok":
+            h.tokens = [1, 2, 3]
+            h.ttft_s = self.ttft
+        h.result = result
+        h.done.set()
+
+    def cancel(self, h):
+        if h.done.is_set():
+            return False
+        self.canceled.append(h)
+        self.complete(h, "canceled")
+        return True
+
+
+class FakeClock:
+    """Deterministic monotonic clock; the router's injected sleep advances
+    it so backoff/cooldown logic runs without wall time."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+    def advance(self, s):
+        self.t += s
+
+
+def mk_router(engines, **kw):
+    clk = kw.pop("clk", None) or FakeClock()
+    kw.setdefault("clock", clk)
+    kw.setdefault("sleep", clk.sleep)
+    kw.setdefault("rng", random.Random(0))
+    router = TokenRouter(endpoint="ep", **kw)
+    for i, eng in enumerate(engines):
+        router.add_replica(i, eng)
+    return router, clk
+
+
+# ---------------------------------------------------------------------------
+# picking
+# ---------------------------------------------------------------------------
+
+
+def test_pick_routes_to_least_loaded_replica():
+    busy = FakeEngine(queued=5, active=4, slots=4)
+    idle = FakeEngine(queued=0, active=0, slots=4)
+    router, _ = mk_router([busy, idle])
+    assert router.pick() == 1
+    res = router.generate([1, 2], max_new=4)
+    assert isinstance(res, RouteResult)
+    assert res.replica == 1 and res.retries == 0 and not res.hedged
+    assert idle.submitted and not busy.submitted
+
+
+def test_observed_ttft_tail_penalizes_slow_replica():
+    slow = FakeEngine(ttft=5.0)
+    fast = FakeEngine(ttft=0.001)
+    router, _ = mk_router([slow, fast])
+    # seed the router's per-replica TTFT view through real requests
+    for idx in (0, 1):
+        for _ in range(4):
+            router._finish(router._replicas[idx], slow.submit([1], 1)
+                           if idx == 0 else fast.submit([1], 1))
+    assert router.pick() == 1
+
+
+# ---------------------------------------------------------------------------
+# ejection + bounded re-admission
+# ---------------------------------------------------------------------------
+
+
+def test_ejection_then_bounded_readmission():
+    flaky = FakeEngine(queued=0)  # most attractive score
+    steady = FakeEngine(queued=2)
+    router, clk = mk_router(
+        [flaky, steady], breaker_failure_threshold=2, breaker_cooldown_s=10.0,
+    )
+    router.note_probe_failure(0)
+    assert router.ejected() == []  # one failure is below the threshold
+    router.note_probe_failure(0)
+    assert router.ejected() == [0]
+    # ejected replica leaves rotation even though its score is best
+    assert router.pick() == 1
+    before = sm.inference_router_ejections_total.value(action="readmit")
+    # inside the cooldown the breaker stays shut
+    clk.advance(5.0)
+    assert router.pick() == 1
+    # past the cooldown: exactly one half-open trial is admitted, and a
+    # successful request through it re-admits the replica
+    clk.advance(6.0)
+    res = router.generate([1], max_new=2)
+    assert res.replica == 0
+    assert router.ejected() == []
+    assert sm.inference_router_ejections_total.value(action="readmit") == before + 1
+
+
+def test_failed_halfopen_trial_reejects_with_longer_cooldown():
+    dead = FakeEngine(mode="error", queued=0)
+    ok = FakeEngine(queued=3)
+    router, clk = mk_router(
+        [dead, ok], breaker_failure_threshold=1, breaker_cooldown_s=2.0,
+        max_retries=1,
+    )
+    res = router.generate([1], max_new=2)  # fails on 0, retried on 1
+    assert res.replica == 1 and res.retries == 1
+    assert router.ejected() == [0]
+    clk.advance(2.5)  # half-open trial admitted...
+    res = router.generate([1], max_new=2)  # ...fails again -> re-ejected
+    assert res.replica == 1
+    clk.advance(2.5)  # doubled cooldown: still shut
+    assert router.pick() == 1
+
+
+# ---------------------------------------------------------------------------
+# retries: idempotent failures move to a DIFFERENT replica
+# ---------------------------------------------------------------------------
+
+
+def test_error_retries_on_different_replica():
+    broken = FakeEngine(mode="error", queued=0)
+    healthy = FakeEngine(queued=1)
+    router, _ = mk_router([broken, healthy], breaker_failure_threshold=1)
+    res = router.generate([1, 2], max_new=4)
+    assert res.replica == 1 and res.retries == 1
+    assert healthy.submitted and not healthy.canceled
+    assert router.ejected() == [0]  # the error also fed the breaker
+
+
+def test_queue_full_retries_without_ejecting():
+    full = FakeEngine(mode="queue_full", queued=0)
+    healthy = FakeEngine(queued=1)
+    router, _ = mk_router([full, healthy], breaker_failure_threshold=1)
+    res = router.generate([1, 2], max_new=4)
+    assert res.replica == 1 and res.retries == 1
+    assert router.ejected() == []  # full is load, not failure
+
+
+def test_canceled_midflight_retries_elsewhere():
+    torn_down = FakeEngine(mode="canceled", queued=0)
+    healthy = FakeEngine(queued=1)
+    router, _ = mk_router([torn_down, healthy], breaker_failure_threshold=3)
+    res = router.generate([1, 2], max_new=4)
+    assert res.replica == 1 and res.retries == 1
+
+
+def test_retry_budget_exhausts_to_the_callers_error():
+    router, _ = mk_router(
+        [FakeEngine(mode="error"), FakeEngine(mode="error")],
+        breaker_failure_threshold=100, max_retries=2,
+    )
+    with pytest.raises(ConnectionError):
+        router.generate([1], max_new=2)
+
+
+def test_backoff_is_jittered_exponential_and_capped():
+    router, clk = mk_router([FakeEngine()], max_retries=3)
+    t0 = clk.t
+    router._backoff(1)
+    first = clk.t - t0
+    assert 0.005 <= first <= 0.01  # base 10ms, jitter in [0.5, 1.0]
+    t1 = clk.t
+    router._backoff(10)  # far past the cap
+    assert clk.t - t1 <= 0.25
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_launches_and_winner_cancels_the_loser():
+    stuck = FakeEngine(mode="hang", queued=0)  # preferred, never finishes
+    quick = FakeEngine(queued=1)
+    router, _ = mk_router(
+        [stuck, quick], hedge_after_s=0.001,
+        clock=time.monotonic, sleep=time.sleep, clk=FakeClock(),
+    )
+    # real clock: hedging polls both handles on wall time
+    router.clock = time.monotonic
+    router.sleep = time.sleep
+    res = router.generate([1, 2], max_new=4, wait_timeout_s=5.0)
+    assert res.hedged and res.hedge_won and res.replica == 1
+    # the loser was canceled, not left decoding a duplicate answer
+    assert stuck.canceled and stuck.canceled[0].result == "canceled"
+    assert quick.submitted[0].result == "ok"
+
+
+def test_hedge_primary_win_cancels_the_hedge():
+    primary = FakeEngine(mode="hang", queued=0)
+    backup = FakeEngine(mode="hang", queued=1)
+    router, _ = mk_router(
+        [primary, backup], hedge_after_s=0.001, clk=FakeClock(),
+    )
+    router.clock = time.monotonic
+    router.sleep = time.sleep
+    done = {}
+
+    def run():
+        done["res"] = router.generate([1], max_new=2, wait_timeout_s=5.0)
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not (primary.submitted and backup.submitted):
+        assert time.monotonic() < deadline, "hedge never launched"
+        time.sleep(0.002)
+    primary.complete(primary.submitted[0], "ok")
+    t.join(5.0)
+    res = done["res"]
+    assert res.hedged and not res.hedge_won and res.replica == 0
+    assert backup.canceled  # the hedge was canceled
+
+
+# ---------------------------------------------------------------------------
+# drain: no new picks, in-flight work completes
+# ---------------------------------------------------------------------------
+
+
+def test_draining_replica_takes_no_new_picks_but_finishes_inflight():
+    draining = FakeEngine(mode="hang", queued=0)
+    rest = FakeEngine(queued=1)
+    router, _ = mk_router([draining, rest])
+    router.clock = time.monotonic
+    router.sleep = time.sleep
+    done = {}
+
+    def run():
+        done["res"] = router.generate([1], max_new=2, wait_timeout_s=5.0)
+
+    t = threading.Thread(target=run)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not draining.submitted:
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    # the drain starts with a request in flight on replica 0
+    router.set_draining(0)
+    assert router.pick() == 1  # new traffic avoids the draining replica
+    res2 = router.generate([3], max_new=2)
+    assert res2.replica == 1
+    # the in-flight request is NOT dropped: it completes normally
+    draining.complete(draining.submitted[0], "ok")
+    t.join(5.0)
+    assert done["res"].replica == 0 and done["res"].handle.result == "ok"
+    router.set_draining(0, False)
+    assert router.pick() == 0  # back in rotation after the drain withdraws
+
+
+# ---------------------------------------------------------------------------
+# cold-wake + admission
+# ---------------------------------------------------------------------------
+
+
+def test_cold_wake_fires_rate_limited_under_router_flow():
+    wakes = []
+
+    def wake():
+        wakes.append(current_flow())
+
+    router, clk = mk_router([], cold_wake=wake)
+    clk.advance(10.0)
+    with pytest.raises(QueueFull):
+        router.generate([1], max_new=2)
+    assert wakes == ["token-router"]  # flow-classified manager traffic
+    with pytest.raises(QueueFull):
+        router.generate([1], max_new=2)
+    assert len(wakes) == 1  # rate-limited inside the cooldown
+    clk.advance(2.0)
+    with pytest.raises(QueueFull):
+        router.generate([1], max_new=2)
+    assert len(wakes) == 2
+
+
+def test_all_replicas_ejected_sheds_and_wakes_nobody_without_callback():
+    eng = FakeEngine(queued=0)
+    router, _ = mk_router([eng], breaker_failure_threshold=1)
+    router.note_probe_failure(0)
+    before = sm.inference_router_picks_total.value(result="no_replica")
+    with pytest.raises(QueueFull):
+        router.generate([1], max_new=2)
+    assert sm.inference_router_picks_total.value(result="no_replica") == before + 1
+
+
+def test_router_inflight_bound_sheds():
+    stuck = FakeEngine(mode="hang")
+    router, _ = mk_router([stuck], max_inflight=1)
+    router.clock = time.monotonic
+    router.sleep = time.sleep
+    t = threading.Thread(
+        target=lambda: router.generate([1], max_new=2, wait_timeout_s=5.0)
+    )
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not stuck.submitted:
+        assert time.monotonic() < deadline
+        time.sleep(0.002)
+    with pytest.raises(QueueFull):
+        router.generate([2], max_new=2)
+    stuck.complete(stuck.submitted[0], "ok")
+    t.join(5.0)
+
+
+@pytest.mark.flowcontrol
+def test_requests_hold_a_seat_in_the_serving_priority_level():
+    fc = FlowController(
+        schemas=[
+            FlowSchema("serving-requests", "serving",
+                       kinds=("InferenceRequest",)),
+            FlowSchema("catch-all", "default"),
+        ],
+        levels=[
+            PriorityLevel("serving", seats=1, queue_length=0,
+                          queue_timeout_s=0.05),
+            PriorityLevel("default", seats=4),
+        ],
+    )
+    # the default controller classifies InferenceRequest traffic into the
+    # serving level regardless of the per-endpoint flow name
+    assert FlowController().classify(
+        "serving:ep", verb="create", kind="InferenceRequest"
+    ).name == "serving"
+    router, _ = mk_router([FakeEngine()], flow_controller=fc)
+    router.generate([1], max_new=2)
+    assert fc.summary()["serving"]["dispatched"] == 1
+    hog = fc.admit("serving:other", verb="create", kind="InferenceRequest")
+    try:
+        before = sm.inference_router_picks_total.value(result="shed")
+        with pytest.raises(QueueFull):  # 429 idiom at the router boundary
+            router.generate([1], max_new=2)
+        assert sm.inference_router_picks_total.value(result="shed") == before + 1
+        assert fc.summary()["serving"]["rejected"] >= 1
+    finally:
+        hog.release()
+
+
+# ---------------------------------------------------------------------------
+# the seeded router bad day (cluster/faults.py — the ci/faults.sh router
+# lane's chaos schedule)
+# ---------------------------------------------------------------------------
+
+
+class StubCluster:
+    """Just enough cluster for the schedule: preemption calls are recorded,
+    probe partitions + the control-plane rules land in a real injector."""
+
+    def __init__(self):
+        from odh_kubeflow_tpu.cluster.faults import FaultInjector
+
+        self.faults = FaultInjector()
+        self.preempted = []
+
+    def preempt_node(self, name, grace_s=0.5):
+        self.preempted.append((name, grace_s))
+
+
+@pytest.mark.faults
+def test_seeded_router_bad_day_is_deterministic_and_enacts_the_plan():
+    from odh_kubeflow_tpu.cluster.faults import seeded_router_bad_day
+
+    replica_nodes = {
+        0: ["node-r0-a", "node-r0-b"],
+        1: ["node-r1-a", "node-r1-b"],
+        2: ["node-r2-a", "node-r2-b"],
+    }
+    plans = []
+    for _ in range(2):
+        cluster = StubCluster()
+        plans.append(
+            seeded_router_bad_day(cluster, seed=7,
+                                  replica_nodes=replica_nodes)
+        )
+    assert plans[0] == plans[1]  # same seed -> identical bad day
+    plan = plans[0]
+    # one whole gang is the preemption victim — every one of its hosts
+    assert plan["killed_replica"] in replica_nodes
+    assert plan["preempted"] == sorted(replica_nodes[plan["killed_replica"]])
+    assert [n for n, _ in cluster.preempted] == plan["preempted"]
+    # the slow replica SURVIVES (the router must route around it, not lose it)
+    assert plan["slow_replica"] != plan["killed_replica"]
+    assert plan["slow_factor"] > 1.0
+    # probe flaps are count-bounded rules on surviving hosts
+    assert plan["probe_flap_hosts"]
+    for host in plan["probe_flap_hosts"]:
+        assert host not in plan["preempted"]
+    # the control-plane schedule rode along (seeded_bad_day rules installed)
+    assert len(cluster.faults._rules) > len(plan["probe_flap_hosts"])
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.observability
+def test_router_metric_families_render():
+    router, _ = mk_router([FakeEngine()])
+    router.generate([1], max_new=2)
+    text = sm.global_registry.render()
+    for family in (
+        "inference_router_picks_total",
+        "inference_router_retries_total",
+        "inference_router_hedges_total",
+        "inference_router_ejections_total",
+        "inference_router_added_latency_seconds_bucket",
+    ):
+        assert family in text, family
+    assert sm.inference_router_picks_total.value(result="ok") >= 1
