@@ -745,6 +745,194 @@ def _bench_fleet_episode():
     }
 
 
+def bench_accounting():
+    """ISSUE 17 chip-time ledger numbers, scripted and deterministic (no
+    jax, no hardware): a 180-sim-second fleet episode on SimCluster driven
+    through the ChipAccountant on an injected clock, touching every phase
+    in the taxonomy (ready / starting / idle-bound / suspended-warm /
+    repairing / draining / pool-free / reclaim-churn).
+
+    Two headlines ride this episode (bench/ledger.py):
+
+    - **fleet_utilization** — fraction of accounted chip-seconds in the
+      productive phases (ready | draining). The episode script is fixed, so
+      this number only moves when the CLASSIFIER moves — a regression means
+      the phase mapping started mis-attributing chips.
+    - **chip_seconds_per_ready_notebook** — total chip-seconds the notebook
+      class consumed (starting/idle/repair overhead included) per notebook
+      that reached ready. The end-to-end cost of keeping a notebook served;
+      lower is better.
+
+    INVCHECK is armed for the whole episode, so every tick also re-verifies
+    the conservation invariant — a double- or zero-attribution fails the
+    bench, not just the test suite.
+    """
+    import os
+    from datetime import datetime, timezone
+
+    from odh_kubeflow_tpu.api.core import (
+        Container, Node, Pod, ResourceRequirements,
+    )
+    from odh_kubeflow_tpu.api.job import TPUJob
+    from odh_kubeflow_tpu.api.notebook import Notebook
+    from odh_kubeflow_tpu.api.notebook.v1beta1 import TPUStatus
+    from odh_kubeflow_tpu.cluster import SimCluster
+    from odh_kubeflow_tpu.cluster.slicepool import (
+        POOL_CLAIMED_BY_ANNOTATION, POOL_PRIORITY_ANNOTATION,
+        POOL_STATE_ANNOTATION, POOL_STATE_CLAIMED, POOL_STATE_WARM,
+    )
+    from odh_kubeflow_tpu.controllers import constants as CC
+    from odh_kubeflow_tpu.runtime.accounting import ChipAccountant
+    from odh_kubeflow_tpu.tpu import TPU_RESOURCE
+
+    def iso(t):
+        return (
+            datetime.fromtimestamp(t, tz=timezone.utc)
+            .isoformat()
+            .replace("+00:00", "Z")
+        )
+
+    clk = {"t": 0.0}
+    cluster = SimCluster().start()
+    prev_invcheck = os.environ.get("INVCHECK")
+    os.environ["INVCHECK"] = "1"
+    try:
+        # 6 v5e 2x2 slices = 6 single-host pools x 4 chips = 24 chips
+        cluster.add_tpu_pool("acct", "v5e", "2x2", slices=6)
+        acct = ChipAccountant(
+            cluster.client, idle_after_s=100.0, clock=lambda: clk["t"]
+        )
+
+        def node_of(pool):
+            return cluster.client.get(Node, "", f"{pool}-w0")
+
+        def annotate_node(pool, updates):
+            node = node_of(pool)
+            for k, v in updates.items():
+                if v is None:
+                    node.metadata.annotations.pop(k, None)
+                else:
+                    node.metadata.annotations[k] = v
+            cluster.client.update(node)
+
+        def bind_pod(name, pool, owner_label, owner):
+            pod = Pod()
+            pod.metadata.name = name
+            pod.metadata.namespace = "bench"
+            pod.metadata.labels = {owner_label: owner}
+            pod.spec.node_name = f"{pool}-w0"
+            pod.spec.containers = [Container(
+                name="tpu",
+                image="work:1",
+                resources=ResourceRequirements(requests={TPU_RESOURCE: "4"}),
+            )]
+            cluster.client.create(pod)
+
+        def set_notebook(name, **ann):
+            nb = cluster.client.get(Notebook, "bench", name)
+            for k, v in ann.items():
+                key = {
+                    "suspend": CC.TPU_SUSPEND_STATE_ANNOTATION,
+                    "activity": CC.LAST_ACTIVITY_ANNOTATION,
+                }[k]
+                nb.metadata.annotations[key] = v
+            cluster.client.update(nb)
+
+        def run_until(t_end, step=5.0):
+            while clk["t"] < t_end:
+                clk["t"] = min(t_end, clk["t"] + step)
+                acct.tick()
+
+        # t=0: four mesh-ready notebooks bound to acct-0..3, two free pools
+        for i in range(4):
+            nb = Notebook()
+            nb.metadata.name = f"nb-{i}"
+            nb.metadata.namespace = "bench"
+            nb.metadata.annotations[CC.LAST_ACTIVITY_ANNOTATION] = iso(0)
+            nb.status.tpu = TPUStatus(mesh_ready=True)
+            cluster.client.create(nb)
+            bind_pod(f"nb-{i}-pod", f"acct-{i}", CC.NOTEBOOK_NAME_LABEL,
+                     f"nb-{i}")
+        acct.tick()  # baseline
+        run_until(60)  # 4x ready, 2x pool-free
+
+        # t=60: nb-3 begins suspending (checkpointing -> draining); nb-0/1
+        # stay active, nb-2's kernel goes quiet (idle-bound past t=100)
+        set_notebook("nb-3", suspend="checkpointing")
+        set_notebook("nb-0", activity=iso(60))
+        set_notebook("nb-1", activity=iso(60))
+        run_until(80)
+
+        # t=80: nb-3 suspended; its slice returns to the pool WARM and is
+        # held on the suspended owner's behalf (suspended-warm)
+        cluster.client.delete(Pod, "bench", "nb-3-pod")
+        set_notebook("nb-3", suspend="suspended")
+        annotate_node("acct-3", {
+            POOL_STATE_ANNOTATION: POOL_STATE_WARM,
+            POOL_PRIORITY_ANNOTATION: "10",
+        })
+        run_until(120)
+
+        # t=120: nb-1's host fails silently (repairing); a training job
+        # claims pool acct-4 (reclaim-churn: the claim->bind window)
+        cluster.fail_node("acct-1-w0")
+        annotate_node("acct-4", {
+            POOL_STATE_ANNOTATION: POOL_STATE_CLAIMED,
+            POOL_CLAIMED_BY_ANNOTATION: "bench/train-a",
+        })
+        set_notebook("nb-0", activity=iso(120))
+        set_notebook("nb-1", activity=iso(120))
+        run_until(150)
+
+        # t=150: host healed; the job binds (starting), then runs
+        cluster.restore_node("acct-1-w0")
+        job = TPUJob()
+        job.metadata.name = "train-a"
+        job.metadata.namespace = "bench"
+        job.metadata.annotations[CC.JOB_STATE_ANNOTATION] = "admitted"
+        cluster.client.create(job)
+        annotate_node("acct-4", {
+            POOL_STATE_ANNOTATION: None,
+            POOL_CLAIMED_BY_ANNOTATION: None,
+        })
+        bind_pod("train-a-pod", "acct-4", CC.JOB_NAME_LABEL, "train-a")
+        run_until(165)
+        job = cluster.client.get(TPUJob, "bench", "train-a")
+        job.metadata.annotations[CC.JOB_STATE_ANNOTATION] = "running"
+        cluster.client.update(job)
+        set_notebook("nb-0", activity=iso(165))
+        set_notebook("nb-1", activity=iso(165))
+        run_until(180)
+
+        snap = acct.snapshot()
+        cons = acct.conservation()
+        notebook_chip_s = acct.chip_seconds(workload_class="notebook")
+        ready_notebooks = 4  # all four banked ready time in the script
+        return {
+            "fleet_utilization": snap["fleet_utilization"],
+            "chip_seconds_per_ready_notebook": round(
+                notebook_chip_s / ready_notebooks, 3
+            ),
+            "conservation_residual_ratio": cons["residual_ratio"],
+            "physical_chip_seconds": round(
+                cons["physical_chip_seconds"], 3
+            ),
+            "by_phase": snap["chip_seconds"]["by_phase"],
+            "by_class": snap["chip_seconds"]["by_class"],
+            "phases_observed": len(snap["chip_seconds"]["by_phase"]),
+            "ticks": snap["ticks"],
+            "note": "scripted 180-sim-second episode on an injected clock; "
+                    "INVCHECK armed every tick — numbers move only when the "
+                    "classifier moves",
+        }
+    finally:
+        if prev_invcheck is None:
+            os.environ.pop("INVCHECK", None)
+        else:
+            os.environ["INVCHECK"] = prev_invcheck
+        cluster.stop()
+
+
 def bench_serving():
     """Continuous batching vs the static-batch generate() baseline at EQUAL
     batch slots under a mixed-length request stream (ISSUE 9 acceptance:
@@ -1767,6 +1955,25 @@ def main() -> None:
         detail["ring_balance"] = bench_ring_balance()
     except Exception as e:
         detail["ring_balance"] = {"error": repr(e)[:300]}
+
+    # fleet chip-time ledger episode (ISSUE 17) — sim-clocked, always
+    # recorded: fleet_utilization + chip_seconds_per_ready_notebook
+    try:
+        detail["accounting"] = bench_accounting()
+    except Exception as e:
+        detail["accounting"] = {"error": repr(e)[:300]}
+
+    # the ISSUE 16 serving-fleet episode is CPU-capable (tiny model, sim
+    # cluster); on a TPU run bench_serving carries it, on a CPU-only run
+    # record it here so router_added_latency_p50_ms / scale_up_reaction_s
+    # land in the committed round with non-null vs_prior deltas
+    if not on_tpu:
+        try:
+            detail["serving"] = {"fleet": _bench_fleet_episode()}
+        except SystemExit as e:
+            detail["serving"] = {"fleet": {"error": str(e)}}
+        except Exception as e:
+            detail["serving"] = {"fleet": {"error": repr(e)[:300]}}
 
     # watchdog: the dispatch tunnel occasionally wedges with the main thread
     # blocked inside a C extension call (observed in round 3: trivial ops

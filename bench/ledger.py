@@ -122,6 +122,25 @@ HEADLINES: Tuple[Headline, ...] = (
              "committed round carries it yet",
     ),
     Headline(
+        name="fleet_utilization",
+        path=("detail", "accounting", "fleet_utilization"),
+        direction="higher",
+        tolerance=0.05,
+        note="fraction of accounted chip-seconds in productive phases over "
+             "the scripted ISSUE 17 episode; the script is deterministic "
+             "on a sim clock, so any movement is a classifier change — "
+             "tight tolerance on purpose",
+    ),
+    Headline(
+        name="chip_seconds_per_ready_notebook",
+        path=("detail", "accounting", "chip_seconds_per_ready_notebook"),
+        direction="lower",
+        tolerance=0.05,
+        note="end-to-end chip-second cost per notebook that reached ready "
+             "in the scripted ISSUE 17 episode (starting/idle/repair "
+             "overhead included); deterministic sim clock, tight tolerance",
+    ),
+    Headline(
         name="cr_to_mesh_ready_p50_s",
         path=("detail", "control_plane", "cr_to_mesh_ready_p50_s"),
         direction="lower",

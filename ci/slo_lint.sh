@@ -23,6 +23,12 @@
 # completion histograms and the goodput gauge (runtime/jobmetrics.py —
 # jax-free for the same reason), so a renamed job family or a dead label
 # fails here, not in a dashboard.
+#
+# Since ISSUE 17 it covers the fleet accounting layer: the
+# `fleet-utilization` SLO's tpu_fleet_utilization_ratio gauge plus the
+# tpu_chip_seconds_total{workload_class,phase} ledger family
+# (runtime/accounting.py — jax-free again), so the conservation ledger's
+# exported surface is lint-checked with everything else.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -181,6 +181,15 @@ def run(args) -> None:
                             http_get=http_get, shard=ShardSpec(0, 2),
                             lease_duration=lease, renew_period=renew,
                             register_webhook=False)
+    # back-to-back tiers share one process: the cumulative goodput ledgers
+    # (runtime/accounting.py) must not inherit a previous tier's wall-clock
+    # (ISSUE 17 bugfix — the old module-level accumulators never reset)
+    from odh_kubeflow_tpu.runtime import jobmetrics
+    from odh_kubeflow_tpu.tpu import telemetry as tpu_telemetry
+
+    jobmetrics.reset_for_test()
+    tpu_telemetry.goodput.reset_for_test()
+
     fenced0 = rm.fenced_writes_total.value()
     mgr0.start(wait_for_leadership_timeout=10)
     mgr1.start(wait_for_leadership_timeout=10)
@@ -502,6 +511,63 @@ def run(args) -> None:
             failures.append("shard-1 manager unhealthy at tier end")
 
         # ------------------------------------------------------------------
+        # chip-time conservation gate (ISSUE 17): the SURVIVOR's accountant
+        # kept the ledger through the storm and the takeover — summed phase
+        # chip-seconds must equal physical chips x its accounted wall-clock
+        # within 1%, and a classification pass over the final state must
+        # attribute every TPU node exactly once (zero unattributed)
+        # ------------------------------------------------------------------
+        accounting_section = None
+        acct = getattr(standby, "accountant", None)
+        if acct is None:
+            failures.append("surviving manager carries no chip accountant")
+        else:
+            acct.tick()  # close the ledger at tier end
+            cons = acct.conservation()
+            snap = acct.snapshot(limit=10)
+            if snap["ticks"] < 1:
+                failures.append("chip accountant never ticked on the survivor")
+            if cons["residual_ratio"] > 0.01:
+                failures.append(
+                    f"chip-time conservation broken: attributed "
+                    f"{cons['attributed_chip_seconds']:.1f} chip-s vs "
+                    f"physical {cons['physical_chip_seconds']:.1f} chip-s "
+                    f"(residual {cons['residual_ratio']:.2%} > 1%)"
+                )
+            attrs = acct.classify()
+            counts = {}
+            for a in attrs:
+                counts[a.node] = counts.get(a.node, 0) + 1
+            from odh_kubeflow_tpu.api.core import Node as _Node
+            from odh_kubeflow_tpu.tpu import TPU_RESOURCE as _TPU
+            tpu_nodes = {
+                n.metadata.name for n in cluster.client.list(_Node)
+                if int(n.status.capacity.get(_TPU, "0") or 0) > 0
+            }
+            unattributed = sorted(tpu_nodes - set(counts))
+            doubled = sorted(n for n, c in counts.items() if c > 1)
+            if unattributed:
+                failures.append(
+                    f"{len(unattributed)} TPU node(s) unattributed at tier "
+                    f"end: {unattributed[:5]}"
+                )
+            if doubled:
+                failures.append(
+                    f"TPU node(s) double-attributed at tier end: {doubled[:5]}"
+                )
+            accounting_section = {
+                "conservation": {
+                    k: round(v, 4) for k, v in cons.items()
+                },
+                "ticks": snap["ticks"],
+                "fleet_utilization": snap["fleet_utilization"],
+                "by_phase": snap["chip_seconds"]["by_phase"],
+                "by_class": snap["chip_seconds"]["by_class"],
+                "unattributed_nodes": len(unattributed),
+                "double_attributed_nodes": len(doubled),
+            }
+
+        # ------------------------------------------------------------------
         # the verdict comes from the SURVIVOR's judgement layer
         # ------------------------------------------------------------------
         statuses = standby.slo_engine.evaluate()
@@ -564,6 +630,7 @@ def run(args) -> None:
                 }
                 for level, stats in summary.items()
             },
+            "accounting": accounting_section,
             "slo_gates": gates,
             "alerts_firing_gated": list(firing),
             "alerts_firing_all": list(all_firing),

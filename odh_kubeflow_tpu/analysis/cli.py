@@ -49,6 +49,7 @@ def _registry_lint() -> int:
     """Import every metric-registration site, then lint the live global
     registry — the Python half metrics_lint.sh delegates to."""
     import odh_kubeflow_tpu.cluster.slicepool  # noqa: F401
+    import odh_kubeflow_tpu.runtime.accounting  # noqa: F401  (fleet ledger)
     import odh_kubeflow_tpu.runtime.controller  # noqa: F401
     import odh_kubeflow_tpu.runtime.jobmetrics  # noqa: F401  (TPUJob series)
     import odh_kubeflow_tpu.runtime.metrics as m

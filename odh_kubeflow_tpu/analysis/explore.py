@@ -791,6 +791,44 @@ def steady_violations(world: World) -> List[invcheck.InvariantViolation]:
                   f"node {node.metadata.name} quiesced claimed by "
                   f"{claimant!r}, which does not exist — a phantom claim "
                   "holding the slice out of the pool forever")
+    # chip-accounting attribution (ISSUE 17): the ledger's conservation
+    # contract depends on classify() being exhaustive and exclusive — every
+    # TPU node maps to exactly ONE valid (class, phase) bucket in every
+    # reachable quiesced state, so no chip-second can ever go unattributed
+    # or be double-counted regardless of which interleaving produced the
+    # state. The wall-clock half (sum == chips x dt) is the INVCHECK-armed
+    # runtime check; THIS half is interleaving coverage.
+    from ..runtime.accounting import PHASES, ChipAccountant
+
+    accountant = ChipAccountant(world.client, clock=lambda: 0.0)
+    try:
+        attrs = accountant.classify(now=0.0)
+    except Exception as e:  # classification must never throw on a real state
+        v("accounting-attribution",
+          f"classify() raised on a quiesced reachable state: {e!r}")
+        attrs = []
+    from ..tpu import TPU_RESOURCE
+    tpu_nodes = {
+        n.metadata.name
+        for n in world.client.list(Node)
+        if int(n.status.capacity.get(TPU_RESOURCE, "0") or 0) > 0
+    }
+    seen: Dict[str, int] = {}
+    for a in attrs:
+        seen[a.node] = seen.get(a.node, 0) + 1
+        if a.phase not in PHASES:
+            v("accounting-attribution",
+              f"node {a.node} attributed to unknown phase {a.phase!r}")
+    for name, count in seen.items():
+        if count > 1:
+            v("accounting-attribution",
+              f"node {name} attributed {count} times in one pass — its "
+              "chip-seconds would be double-counted")
+    missing = tpu_nodes - set(seen)
+    if missing:
+        v("accounting-attribution",
+          f"TPU node(s) {sorted(missing)} unattributed — their "
+          "chip-seconds would leak from the conservation ledger")
     return out
 
 

@@ -136,6 +136,11 @@ class Config:
     autoscale_period_s: float = 0.0
     autoscale_stabilization_s: float = 30.0
     autoscale_idle_s: float = 120.0
+    # fleet chip-time accountant (runtime/accounting.py): period 0 disables
+    # the ledger service and gates the main.py wiring; idle window is the
+    # threshold past which a bound+ready notebook counts idle-bound
+    accounting_period_s: float = 1.0
+    accounting_idle_after_s: float = 300.0
     # token router (serving/router.py): consecutive failures before a
     # replica is ejected, and the tail-hedge trigger (0 disables hedging)
     router_eject_failures: int = 3
@@ -286,6 +291,14 @@ class Config:
             )
         if os.environ.get("AUTOSCALE_IDLE_S"):
             c.autoscale_idle_s = max(0.0, float(os.environ["AUTOSCALE_IDLE_S"]))
+        if os.environ.get("ACCOUNTING_PERIOD_S"):
+            c.accounting_period_s = max(
+                0.0, float(os.environ["ACCOUNTING_PERIOD_S"])
+            )
+        if os.environ.get("ACCOUNTING_IDLE_AFTER_S"):
+            c.accounting_idle_after_s = max(
+                0.0, float(os.environ["ACCOUNTING_IDLE_AFTER_S"])
+            )
         if os.environ.get("ROUTER_EJECT_FAILURES"):
             # clamp: 0 would eject a replica on its first hiccup forever
             c.router_eject_failures = max(
@@ -409,6 +422,11 @@ ENV_CONTRACT: tuple = (
             "default scale-down stabilization window (flap damping)"),
     EnvKnob("AUTOSCALE_IDLE_S", "120", "controllers/config.py",
             "default idle window before scale-to-zero parks an endpoint"),
+    EnvKnob("ACCOUNTING_PERIOD_S", "1", "controllers/config.py",
+            "chip-time accountant tick period (0 disables; also gates "
+            "main.py wiring)"),
+    EnvKnob("ACCOUNTING_IDLE_AFTER_S", "300", "controllers/config.py",
+            "activity staleness before bound chips count idle-bound"),
     EnvKnob("ROUTER_EJECT_FAILURES", "3", "controllers/config.py",
             "consecutive failures before the router ejects a replica"),
     EnvKnob("ROUTER_HEDGE_AFTER_S", "0", "controllers/config.py",

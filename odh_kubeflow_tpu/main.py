@@ -161,6 +161,20 @@ def _wire_observability(mgr: Manager, config: Config) -> None:
         )
         mgr.autoscaler = autoscaler
         mgr.add_service(autoscaler)
+    if config.accounting_period_s > 0:
+        from .runtime import accounting
+
+        accountant = accounting.ChipAccountant(
+            mgr.client,
+            period_s=config.accounting_period_s,
+            idle_after_s=config.accounting_idle_after_s,
+        )
+        # module handle: the flight recorder freezes this accountant's
+        # snapshot into incident bundles; /debug/accounting reads it via
+        # the named manager attribute
+        accounting.set_current(accountant)
+        mgr.accountant = accountant
+        mgr.add_service(accountant)
 
 
 def serve_webhook(client, config: Config, cert_dir: str, port: int = 8443):

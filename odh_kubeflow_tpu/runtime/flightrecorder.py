@@ -169,6 +169,20 @@ class FlightRecorder:
                     bundle["profile"] = prof
         except Exception:  # pragma: no cover - never costs the bundle
             pass
+        # ISSUE 17: freeze the fleet chip-time ledger — an incident carries
+        # its own where-did-the-chips-go evidence (per-phase chip-seconds,
+        # conservation arithmetic, top consumers). Same never-costs-the-
+        # bundle discipline as the profiler block above.
+        try:
+            from . import accounting
+
+            acct = accounting.current()
+            if acct is not None:
+                snap = acct.snapshot(limit=16)
+                if snap["ticks"] > 0:
+                    bundle["accounting"] = snap
+        except Exception:  # pragma: no cover - never costs the bundle
+            pass
         with self._lock:
             self._incidents.append(bundle)
         flight_recorder_incidents_total.inc(reason=reason)

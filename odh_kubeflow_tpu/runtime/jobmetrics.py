@@ -11,8 +11,6 @@ rate, not ad-hoc thresholds.
 """
 from __future__ import annotations
 
-import threading
-
 from .metrics import global_registry
 
 tpu_job_queue_wait_seconds = global_registry.histogram(
@@ -53,19 +51,22 @@ tpu_job_goodput_ratio = global_registry.gauge(
     "progress lost since the last checkpoint all burn the ratio",
 )
 
-# cumulative goodput accumulators behind the gauge (module-level so every
-# controller instance in a process feeds one ratio, the record_claim idiom);
-# locked: terminal jobs land from concurrent reconcile workers
-_goodput = {"productive_s": 0.0, "wall_s": 0.0}
-_goodput_lock = threading.Lock()
+# the cumulative accumulators behind the gauge live in the fleet accounting
+# ledger (ISSUE 17: one accounting source of truth) — this module keeps the
+# public family + call surface, the ledger supplies the locking and the
+# `reset_for_test()` the old module-level dict never had (back-to-back
+# loadtest tiers inherited stale wall-clock)
+from .accounting import job_goodput as _ledger  # noqa: E402
+
+_ledger.bind_gauge(tpu_job_goodput_ratio)
 
 
 def record_job_outcome(productive_s: float, wall_s: float) -> None:
     """One terminal job's contribution to the cumulative goodput ratio."""
-    with _goodput_lock:
-        _goodput["productive_s"] += max(0.0, productive_s)
-        _goodput["wall_s"] += max(0.0, wall_s)
-        if _goodput["wall_s"] > 0:
-            tpu_job_goodput_ratio.set(
-                min(1.0, _goodput["productive_s"] / _goodput["wall_s"])
-            )
+    _ledger.record(productive_s, wall_s)
+
+
+def reset_for_test() -> None:
+    """Zero the cumulative goodput ledger AND its gauge — soak/loadtest
+    isolation between back-to-back tiers in one process."""
+    _ledger.reset_for_test()

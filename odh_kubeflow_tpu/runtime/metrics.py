@@ -53,6 +53,13 @@ class _Metric:
                 for k, v in self._values.items()
             ]
 
+    def clear(self) -> None:
+        """Drop every series, returning the family to its never-observed
+        state (test/loadtest isolation: a cleared ratio gauge reads as
+        no-data to the SLO engine, not as 0.0)."""
+        with self._lock:
+            self._values.clear()
+
     def sum_matching(self, labels: Dict[str, str]) -> float:
         """Sum of series whose labels include every given (name, value) pair
         ({} sums the whole family) — e.g. good events
@@ -137,6 +144,13 @@ class Histogram(_Metric):
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
         self._totals: Dict[Tuple[str, ...], int] = {}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
 
     def observe(self, value: float, **labels: str) -> None:
         with self._lock:

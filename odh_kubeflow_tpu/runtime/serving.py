@@ -153,6 +153,49 @@ class ServingEndpoints:
                             respond_json({"error": "limit must be >= 0"}, 400)
                             return
                     respond_json(profiler.snapshot(region=region, limit=limit))
+                elif path == "/debug/accounting":
+                    # fleet chip-time ledger (ISSUE 17): the conservation
+                    # arithmetic, per-phase/per-class chip-seconds, and the
+                    # per-object detail. ?class= filters by workload class,
+                    # ?object= by ns/name, ?limit= caps the object rows;
+                    # bad args are a 400, same contract as /debug/traces
+                    from . import accounting as acct_mod
+
+                    acct = getattr(serving.manager, "accountant", None)
+                    if acct is None:
+                        acct = acct_mod.current()
+                    if acct is None:
+                        respond_json(
+                            {"error": "accounting disabled "
+                                      "(ACCOUNTING_PERIOD_S=0)"},
+                            404,
+                        )
+                        return
+                    cls = query.get("class")
+                    if cls is not None and cls not in acct_mod.CLASSES:
+                        respond_json(
+                            {"error": f"unknown class {cls!r}; known: "
+                                      f"{sorted(acct_mod.CLASSES)}"},
+                            400,
+                        )
+                        return
+                    limit = None
+                    if "limit" in query:
+                        try:
+                            limit = int(query["limit"])
+                        except ValueError:
+                            respond_json({"error": "limit must be an integer"}, 400)
+                            return
+                        if limit < 0:
+                            respond_json({"error": "limit must be >= 0"}, 400)
+                            return
+                    respond_json(
+                        acct.snapshot(
+                            workload_class=cls,
+                            obj=query.get("object"),
+                            limit=limit,
+                        )
+                    )
                 elif path == "/debug/incidents":
                     rec = serving._recorder()
                     if "id" in query:
@@ -225,6 +268,8 @@ class ServingEndpoints:
             b"API priority &amp; fairness levels (seats, queue, shed)</li>"
             b'<li><a href="/debug/profile">/debug/profile</a> &mdash; '
             b"PROFILE=1 hot-region timings (?region=, ?limit=)</li>"
+            b'<li><a href="/debug/accounting">/debug/accounting</a> &mdash; '
+            b"fleet chip-time ledger (?class=, ?object=, ?limit=)</li>"
             b'<li><a href="/healthz">/healthz</a></li>'
             b"</ul></body></html>\n"
         )

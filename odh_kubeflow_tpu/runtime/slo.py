@@ -159,6 +159,7 @@ def default_slos() -> Tuple[SLO, ...]:
     the lint's live-registry contract honest on a manager image that never
     loads the workload libraries."""
     from ..serving import metrics as _serving_metrics  # noqa: F401
+    from . import accounting as _accounting  # noqa: F401  (fleet ledger)
     from . import jobmetrics as _jobmetrics  # noqa: F401
 
     return (
@@ -217,6 +218,18 @@ def default_slos() -> Tuple[SLO, ...]:
             indicator=GaugeIndicator("tpu_slice_goodput_ratio"),
             description="the fleet spends >= 98% of tracked slice-lifetime "
             "Ready rather than Degraded/Repairing",
+            category="goodput",
+        ),
+        SLO(
+            "fleet-utilization",
+            objective=0.50,
+            indicator=GaugeIndicator("tpu_fleet_utilization_ratio"),
+            description="at least half of accounted chip-seconds land in "
+            "productive phases (ready | draining) — warm-pool debt, repair "
+            "churn, and idle-bound kernels all burn the other half "
+            "(ISSUE 17: the accountant's conservation ledger is the gauge's "
+            "source, so the objective is judged on attributed, not "
+            "sampled, chip time)",
             category="goodput",
         ),
         SLO(

@@ -496,6 +496,9 @@ class ServingEngine:
             ttft_s=round(handle.ttft_s, 6) if handle.ttft_s is not None
             else None,
             result=result,
+            # hedged losers stay in the routed request's trace but are
+            # explicitly marked: the winner's span is the one that counted
+            superseded=handle.superseded,
         )
         handle.done.set()
 

@@ -50,7 +50,13 @@ from ..apimachinery import TooManyRequestsError
 from ..cluster.flowcontrol import FlowController, flow_context
 from ..runtime.breaker import CircuitBreaker
 from ..utils import racecheck
-from ..utils.tracing import record_span
+from ..utils.tracing import (
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+    record_span,
+)
 from . import metrics as M
 from .engine import QueueFull, RequestHandle
 
@@ -204,10 +210,13 @@ class TokenRouter:
         occupancy = float(stats.get("active_slots", 0)) / slots
         return queued + occupancy + rep.ttft_tail_s()
 
-    def pick(self, exclude: Sequence[int] = ()) -> Optional[int]:
+    def pick(self, exclude: Sequence[int] = (),
+             traceparent: Optional[str] = None) -> Optional[int]:
         """Best routable replica index, or None (all ejected / draining /
         excluded / absent). Breaker half-open trials ride the same path:
-        `allow()` admits one probe request per cooldown."""
+        `allow()` admits one probe request per cooldown. `traceparent`
+        (ISSUE 17 stitching) parents the pick span under the routed
+        request's span, so router->replica->first-token is ONE trace."""
         with self._lock:
             candidates = [
                 rep for rep in self._replicas.values()
@@ -221,6 +230,7 @@ class TokenRouter:
         best = min(routable, key=self._score)
         record_span(
             "router.pick",
+            traceparent=traceparent,
             endpoint=self.endpoint,
             replica=best.index,
             candidates=len(routable),
@@ -262,13 +272,35 @@ class TokenRouter:
                         f"router inflight bound reached ({self.max_inflight})"
                     )
                 self._inflight += 1
+            # one routed-request envelope span per admitted request (ISSUE 17
+            # stitching): its context is what pick/retry/hedge spans AND the
+            # replica engines see as traceparent, so the engine-side
+            # inference.request joins this trace instead of starting its own
+            ctx = parse_traceparent(traceparent)
+            trace_id = ctx[0] if ctx else new_trace_id()
+            span_id = new_span_id()
+            route_ctx = format_traceparent(trace_id, span_id)
+            result_tag = "ok"
             try:
                 return self._generate_routed(
-                    prompt, max_new, traceparent, wait_timeout_s, t0
+                    prompt, max_new, route_ctx, wait_timeout_s, t0
                 )
+            except BaseException as e:
+                result_tag = type(e).__name__
+                raise
             finally:
                 with self._lock:
                     self._inflight -= 1
+                record_span(
+                    "router.request",
+                    traceparent=traceparent,
+                    trace_id=trace_id,
+                    span_id=span_id,
+                    start_time=t0,
+                    end_time=self.clock(),
+                    endpoint=self.endpoint,
+                    result=result_tag,
+                )
         finally:
             if ticket is not None:
                 ticket.release()
@@ -284,12 +316,12 @@ class TokenRouter:
         tried: set = set()
         retries = 0
         while True:
-            index = self.pick(exclude=tuple(tried))
+            index = self.pick(exclude=tuple(tried), traceparent=traceparent)
             if index is None and tried:
                 # every untried replica is out; the budget allows revisiting
                 # the full rotation once more rather than shedding early
                 tried.clear()
-                index = self.pick()
+                index = self.pick(traceparent=traceparent)
             if index is None:
                 self._maybe_cold_wake()
                 M.inference_router_picks_total.inc(result="no_replica")
@@ -311,6 +343,10 @@ class TokenRouter:
                 if retries >= self.max_retries:
                     M.inference_router_picks_total.inc(result="shed")
                     raise
+                record_span(
+                    "router.retry", traceparent=traceparent,
+                    reason="queue_full", replica=index, attempt=retries + 1,
+                )
                 tried.add(index)
                 retries += 1
                 self._backoff(retries)
@@ -321,6 +357,10 @@ class TokenRouter:
                 if retries >= self.max_retries:
                     M.inference_router_picks_total.inc(result="error")
                     raise
+                record_span(
+                    "router.retry", traceparent=traceparent,
+                    reason="error", replica=index, attempt=retries + 1,
+                )
                 tried.add(index)
                 retries += 1
                 self._backoff(retries)
@@ -346,6 +386,10 @@ class TokenRouter:
                     f"request canceled on replica {index} and retry budget "
                     f"exhausted ({self.max_retries})"
                 )
+            record_span(
+                "router.retry", traceparent=traceparent,
+                reason="canceled", replica=index, attempt=retries + 1,
+            )
             tried.add(index)
             retries += 1
             self._backoff(retries)
@@ -369,7 +413,9 @@ class TokenRouter:
             if not handle.wait(budget) and not handle.tokens:
                 # slowest-tail hedge: nothing generated yet, try the
                 # next-best replica in parallel; first completion wins
-                hedge_idx = self.pick(exclude=tuple(tried | {rep.index}))
+                hedge_idx = self.pick(
+                    exclude=tuple(tried | {rep.index}), traceparent=traceparent
+                )
                 if hedge_idx is not None:
                     with self._lock:
                         hedge_rep = self._replicas.get(hedge_idx)
@@ -381,6 +427,10 @@ class TokenRouter:
                             hedged = True
                             M.inference_router_hedges_total.inc(
                                 outcome="launched"
+                            )
+                            record_span(
+                                "router.hedge", traceparent=traceparent,
+                                primary=rep.index, hedge=hedge_idx,
                             )
                         except Exception:
                             hedge_rep = None

@@ -103,26 +103,26 @@ class GoodputAccounting:
     every reconcile: the delta since the notebook was last seen extends
     tracked lifetime, and counts as downtime when the notebook was in any
     repair state for that interval. One process-wide instance — goodput is
-    a fleet number."""
+    a fleet number.
+
+    Since ISSUE 17 the accumulators live in the fleet accounting ledger
+    (runtime/accounting.py `slice_goodput`) — this class keeps the public
+    observe() surface as a VIEW, and gains the ledger's reset_for_test():
+    lifetime-downtime is the "good" numerator, lifetime the total."""
 
     def __init__(self) -> None:
-        from ..utils import racecheck
+        from ..runtime.accounting import slice_goodput
 
-        self._lock = racecheck.make_lock("GoodputAccounting._lock")
-        self._observed_s = 0.0
-        self._downtime_s = 0.0
+        self._ledger = slice_goodput
+        self._ledger.bind_gauge(slice_goodput_ratio)
 
     def observe(self, lifetime_s: float, downtime_s: float = 0.0) -> None:
-        with self._lock:
-            self._observed_s += max(0.0, lifetime_s)
-            self._downtime_s += max(0.0, downtime_s)
-            ratio = (
-                max(0.0, 1.0 - self._downtime_s / self._observed_s)
-                if self._observed_s > 0
-                else None
-            )
-        if ratio is not None:
-            slice_goodput_ratio.set(ratio)
+        lifetime_s = max(0.0, lifetime_s)
+        downtime_s = min(max(0.0, downtime_s), lifetime_s)
+        self._ledger.record(lifetime_s - downtime_s, lifetime_s)
+
+    def reset_for_test(self) -> None:
+        self._ledger.reset_for_test()
 
 
 goodput = GoodputAccounting()

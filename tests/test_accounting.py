@@ -1,0 +1,637 @@
+"""Fleet chip-time accounting ledger (runtime/accounting.py, ISSUE 17).
+
+The ChipAccountant attributes every chip-second to one
+(workload_class, object, phase) bucket per tick, with a hard conservation
+contract: summed phase chip-seconds == physical chips x wall-clock. These
+tests drive it on an injected sim clock through the phase taxonomy's real
+transitions (suspend -> warm pool, silent host failure -> repair, pool
+claim -> bind -> running), soak it under a seeded mixed bad day, exercise
+/debug/accounting, prove the INVCHECK-armed conservation check catches a
+doctored double-attribution (and is inert + cheap disarmed), and pin the
+goodput-view migration: job/slice goodput are now views over GoodputLedger
+with the reset_for_test() the old module-level accumulators never had.
+
+Deterministic tier-1 tests (marker: accounting); ci/slo_lint.sh lint-checks
+the exported families against the same live registry.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+from datetime import datetime, timezone
+
+import pytest
+
+from odh_kubeflow_tpu.api.core import (
+    Container,
+    Node,
+    Pod,
+    ResourceRequirements,
+)
+from odh_kubeflow_tpu.api.job import TPUJob
+from odh_kubeflow_tpu.api.notebook import Notebook
+from odh_kubeflow_tpu.api.notebook.v1beta1 import TPUStatus
+from odh_kubeflow_tpu.cluster import SimCluster
+from odh_kubeflow_tpu.cluster.slicepool import (
+    POOL_CLAIMED_BY_ANNOTATION,
+    POOL_PRIORITY_ANNOTATION,
+    POOL_STATE_ANNOTATION,
+    POOL_STATE_CLAIMED,
+    POOL_STATE_WARM,
+)
+from odh_kubeflow_tpu.controllers import constants as CC
+from odh_kubeflow_tpu.runtime import accounting
+from odh_kubeflow_tpu.runtime.accounting import Attribution, ChipAccountant
+from odh_kubeflow_tpu.tpu import TPU_RESOURCE
+from odh_kubeflow_tpu.utils import invcheck
+
+pytestmark = pytest.mark.accounting
+
+CHIPS_PER_SLICE = 4  # v5e 2x2: one host, four chips
+
+
+def iso(t):
+    return (
+        datetime.fromtimestamp(t, tz=timezone.utc)
+        .isoformat()
+        .replace("+00:00", "Z")
+    )
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class World:
+    """SimCluster + sim-clocked accountant + the annotation levers the
+    classifier reads (the same levers the real controllers pull)."""
+
+    def __init__(self, slices=3, idle_after_s=100.0):
+        self.cluster = SimCluster().start()
+        self.cluster.add_tpu_pool("acct", "v5e", "2x2", slices=slices)
+        self.clock = Clock()
+        self.acct = ChipAccountant(
+            self.cluster.client, idle_after_s=idle_after_s, clock=self.clock
+        )
+        self.client = self.cluster.client
+
+    def stop(self):
+        self.cluster.stop()
+
+    def tick_to(self, t_end, step=5.0):
+        while self.clock.t < t_end:
+            self.clock.advance(min(step, t_end - self.clock.t))
+            self.acct.tick()
+
+    def add_notebook(self, name, mesh_ready=True, activity_at=0.0):
+        nb = Notebook()
+        nb.metadata.name = name
+        nb.metadata.namespace = "t"
+        nb.metadata.annotations[CC.LAST_ACTIVITY_ANNOTATION] = iso(activity_at)
+        nb.status.tpu = TPUStatus(mesh_ready=mesh_ready)
+        self.client.create(nb)
+        return nb
+
+    def annotate(self, kind, name, key, value):
+        obj = self.client.get(kind, "t", name)
+        if value is None:
+            obj.metadata.annotations.pop(key, None)
+        else:
+            obj.metadata.annotations[key] = value
+        self.client.update(obj)
+
+    def annotate_node(self, pool, updates):
+        node = self.client.get(Node, "", f"{pool}-w0")
+        for k, v in updates.items():
+            if v is None:
+                node.metadata.annotations.pop(k, None)
+            else:
+                node.metadata.annotations[k] = v
+        self.client.update(node)
+
+    def bind_pod(self, name, pool, owner_label, owner):
+        pod = Pod()
+        pod.metadata.name = name
+        pod.metadata.namespace = "t"
+        pod.metadata.labels = {owner_label: owner}
+        pod.spec.node_name = f"{pool}-w0"
+        pod.spec.containers = [Container(
+            name="tpu",
+            image="work:1",
+            resources=ResourceRequirements(
+                requests={TPU_RESOURCE: str(CHIPS_PER_SLICE)}
+            ),
+        )]
+        self.client.create(pod)
+        return pod
+
+
+@pytest.fixture
+def world():
+    w = World()
+    yield w
+    w.stop()
+
+
+# ---------------------------------------------------------------------------
+# phase-transition attribution on the sim clock
+# ---------------------------------------------------------------------------
+
+
+def test_suspend_episode_attributes_drain_then_warm_hold(world):
+    """ready -> (checkpointing) draining -> release-to-warm-pool held on the
+    suspended owner's behalf (suspended-warm), with the OTHER free slice
+    staying pool-free: the warm/free split is counted owner-side."""
+    world.add_notebook("nb-a")
+    world.bind_pod("nb-a-pod", "acct-0", CC.NOTEBOOK_NAME_LABEL, "nb-a")
+    world.acct.tick()  # baseline at t=0
+    world.tick_to(20)  # 20s ready
+    world.annotate(Notebook, "nb-a", CC.TPU_SUSPEND_STATE_ANNOTATION,
+                   "checkpointing")
+    world.tick_to(30)  # 10s draining
+    world.client.delete(Pod, "t", "nb-a-pod")
+    world.annotate(Notebook, "nb-a", CC.TPU_SUSPEND_STATE_ANNOTATION,
+                   "suspended")
+    world.annotate_node("acct-0", {
+        POOL_STATE_ANNOTATION: POOL_STATE_WARM,
+        POOL_PRIORITY_ANNOTATION: "10",
+    })
+    world.annotate_node("acct-1", {POOL_STATE_ANNOTATION: POOL_STATE_WARM})
+    world.tick_to(50)  # 20s suspended-warm (one slice), warm-surplus free
+
+    acct = world.acct
+    assert acct.chip_seconds(phase="ready") == 20 * CHIPS_PER_SLICE
+    assert acct.chip_seconds(phase="draining") == 10 * CHIPS_PER_SLICE
+    # ONE warm slice is held for the one suspended owner; the second warm
+    # slice and the never-pooled third slice are free capacity
+    assert acct.chip_seconds(phase="suspended-warm") == 20 * CHIPS_PER_SLICE
+    assert acct.chip_seconds(phase="pool-free") == (
+        50 * CHIPS_PER_SLICE  # acct-2 free the whole episode
+        + 30 * CHIPS_PER_SLICE  # acct-1 free until warm-marked, then surplus
+        + 20 * CHIPS_PER_SLICE  # acct-1 as warm surplus
+    )
+    cons = acct.conservation()
+    assert cons["residual_ratio"] == 0.0
+    assert cons["physical_chip_seconds"] == 50 * 3 * CHIPS_PER_SLICE
+
+
+def test_repair_episode_attributes_to_owner_not_pool(world):
+    """A silently failed host under a bound notebook banks repairing
+    chip-seconds AGAINST that notebook (the owner holds the broken slice),
+    then returns to ready after restore."""
+    world.add_notebook("nb-r")
+    world.bind_pod("nb-r-pod", "acct-0", CC.NOTEBOOK_NAME_LABEL, "nb-r")
+    world.acct.tick()
+    world.tick_to(10)
+    world.cluster.fail_node("acct-0-w0")
+    world.tick_to(40)
+    world.cluster.restore_node("acct-0-w0")
+    world.tick_to(50)
+
+    acct = world.acct
+    assert acct.chip_seconds(phase="repairing") == 30 * CHIPS_PER_SLICE
+    assert acct.chip_seconds(workload_class="notebook", phase="repairing") \
+        == 30 * CHIPS_PER_SLICE
+    assert acct.chip_seconds(phase="ready") == 20 * CHIPS_PER_SLICE
+    snap = acct.snapshot(workload_class="notebook")
+    assert snap["objects"][0]["object"] == "t/nb-r"
+    assert snap["objects"][0]["chip_seconds"] == 50 * CHIPS_PER_SLICE
+
+
+def test_reclaim_episode_claim_window_then_job_phases(world):
+    """claimed-but-unbound is reclaim-churn billed to the CLAIMER, the bind
+    lands as starting until the job runs, then ready."""
+    world.acct.tick()
+    world.annotate_node("acct-0", {
+        POOL_STATE_ANNOTATION: POOL_STATE_CLAIMED,
+        POOL_CLAIMED_BY_ANNOTATION: "t/train-z",
+    })
+    world.tick_to(15)  # claim->bind window
+    job = TPUJob()
+    job.metadata.name = "train-z"
+    job.metadata.namespace = "t"
+    job.metadata.annotations[CC.JOB_STATE_ANNOTATION] = "admitted"
+    world.client.create(job)
+    world.annotate_node("acct-0", {
+        POOL_STATE_ANNOTATION: None,
+        POOL_CLAIMED_BY_ANNOTATION: None,
+    })
+    world.bind_pod("train-z-pod", "acct-0", CC.JOB_NAME_LABEL, "train-z")
+    world.tick_to(25)  # admitted = starting
+    world.annotate(TPUJob, "train-z", CC.JOB_STATE_ANNOTATION, "running")
+    world.tick_to(55)  # running = ready
+
+    acct = world.acct
+    assert acct.chip_seconds(phase="reclaim-churn") == 15 * CHIPS_PER_SLICE
+    # the claim window is billed to the claimer object, not anonymous pool
+    churn = [
+        r for r in acct.snapshot()["objects"] if r["object"] == "t/train-z"
+    ]
+    # the claim window rides the claimer's name (class pool), the bound
+    # phases ride the job class — together the whole 55s episode
+    assert sum(r["chip_seconds"] for r in churn) == 55 * CHIPS_PER_SLICE
+    assert any(
+        r["workload_class"] == "pool"
+        and r["chip_seconds"] == 15 * CHIPS_PER_SLICE
+        for r in churn
+    )
+    assert acct.chip_seconds(workload_class="job", phase="starting") \
+        == 10 * CHIPS_PER_SLICE
+    assert acct.chip_seconds(workload_class="job", phase="ready") \
+        == 30 * CHIPS_PER_SLICE
+
+
+def test_stale_activity_turns_ready_into_idle_bound(world):
+    world.add_notebook("nb-i", activity_at=0.0)
+    world.bind_pod("nb-i-pod", "acct-0", CC.NOTEBOOK_NAME_LABEL, "nb-i")
+    world.acct.tick()
+    world.tick_to(100)  # activity fresh enough: ready
+    world.tick_to(160)  # past idle_after_s=100: idle-bound
+    assert world.acct.chip_seconds(phase="ready") == 100 * CHIPS_PER_SLICE
+    assert world.acct.chip_seconds(phase="idle-bound") == 60 * CHIPS_PER_SLICE
+    # fresh activity flips it back
+    world.annotate(Notebook, "nb-i", CC.LAST_ACTIVITY_ANNOTATION, iso(160))
+    world.tick_to(180)
+    assert world.acct.chip_seconds(phase="ready") == 120 * CHIPS_PER_SLICE
+
+
+# ---------------------------------------------------------------------------
+# conservation under a seeded mixed bad-day soak
+# ---------------------------------------------------------------------------
+
+
+def test_conservation_holds_under_seeded_mixed_soak(monkeypatch):
+    """Random (seeded) suspend/fail/claim/bind churn across notebook +
+    inference + job owners, INVCHECK armed the whole soak: every tick
+    re-verifies the exhaustive/exclusive classification and the final
+    ledger balances to ZERO residual against physical chips x wall."""
+    import os
+    import random
+
+    from odh_kubeflow_tpu.api.inference import InferenceEndpoint
+
+    # INVCHECK is armed around every TICK (the conservation check under
+    # test) but not around the chaos writes themselves: the injected
+    # annotation flips deliberately skip the controllers, so the store's
+    # machine-transition monitor would (correctly) flag them
+    monkeypatch.delenv("INVCHECK", raising=False)
+
+    def armed_tick(acct):
+        os.environ["INVCHECK"] = "1"
+        try:
+            return acct.tick()
+        finally:
+            os.environ.pop("INVCHECK", None)
+
+    rng = random.Random(1734)
+    w = World(slices=6)
+    try:
+        # one owner of each class, plus two extra notebooks
+        for i in range(3):
+            w.add_notebook(f"nb-{i}")
+            w.bind_pod(f"nb-{i}-pod", f"acct-{i}", CC.NOTEBOOK_NAME_LABEL,
+                       f"nb-{i}")
+        ep = InferenceEndpoint()
+        ep.metadata.name = "ep-0"
+        ep.metadata.namespace = "t"
+        ep.metadata.annotations[CC.INFERENCE_STATE_ANNOTATION] = "serving"
+        w.client.create(ep)
+        w.bind_pod("ep-0-pod", "acct-3", CC.INFERENCE_NAME_LABEL, "ep-0")
+        job = TPUJob()
+        job.metadata.name = "job-0"
+        job.metadata.namespace = "t"
+        job.metadata.annotations[CC.JOB_STATE_ANNOTATION] = "running"
+        w.client.create(job)
+        w.bind_pod("job-0-pod", "acct-4", CC.JOB_NAME_LABEL, "job-0")
+
+        armed_tick(w.acct)
+        failed = set()
+        for step in range(120):
+            op = rng.randrange(8)
+            if op == 0:
+                w.annotate(Notebook, f"nb-{rng.randrange(3)}",
+                           CC.TPU_SUSPEND_STATE_ANNOTATION,
+                           rng.choice(["checkpointing", "suspended",
+                                       "resuming", None]))
+            elif op == 1:
+                node = f"acct-{rng.randrange(6)}-w0"
+                if node in failed:
+                    failed.discard(node)
+                    w.cluster.restore_node(node)
+                else:
+                    failed.add(node)
+                    w.cluster.fail_node(node)
+            elif op == 2:
+                w.annotate_node("acct-5", {
+                    POOL_STATE_ANNOTATION: rng.choice(
+                        [POOL_STATE_WARM, POOL_STATE_CLAIMED, None]
+                    ),
+                    POOL_CLAIMED_BY_ANNOTATION: rng.choice(
+                        ["t/job-0", None]
+                    ),
+                })
+            elif op == 3:
+                w.annotate(InferenceEndpoint, "ep-0",
+                           CC.INFERENCE_STATE_ANNOTATION,
+                           rng.choice(["serving", "draining", "loading",
+                                       "suspended"]))
+            elif op == 4:
+                w.annotate(TPUJob, "job-0", CC.JOB_STATE_ANNOTATION,
+                           rng.choice(["admitted", "running",
+                                       "checkpointing", "preempted"]))
+            elif op == 5:
+                w.annotate(Notebook, f"nb-{rng.randrange(3)}",
+                           CC.LAST_ACTIVITY_ANNOTATION,
+                           iso(max(0.0, w.clock.t - rng.randrange(300))))
+            # ops 6-7: quiet steps (pure time passage)
+            w.clock.advance(rng.choice([1.0, 3.0, 7.0]))
+            armed_tick(w.acct)
+
+        cons = w.acct.conservation()
+        assert cons["physical_chip_seconds"] == pytest.approx(
+            6 * CHIPS_PER_SLICE * w.clock.t
+        )
+        assert cons["residual_ratio"] < 0.01  # the acceptance tolerance
+        assert cons["residual_ratio"] < 1e-6  # in practice: exact by construction
+        # zero unattributed chip-seconds: every TPU node classified each tick
+        attrs = w.acct.classify()
+        tpu_nodes = {
+            n.metadata.name
+            for n in w.client.list(Node)
+            if int(n.status.capacity.get(TPU_RESOURCE, "0") or 0) > 0
+        }
+        assert {a.node for a in attrs} == tpu_nodes
+        assert len(attrs) == len(tpu_nodes)
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# the armed conservation check: doctored books caught red; disarmed inert
+# ---------------------------------------------------------------------------
+
+
+def _doctor_double_count(acct):
+    real = acct.classify
+
+    def doctored(now=None):
+        attrs = real(now)
+        return attrs + [attrs[0]]  # first node banked twice
+
+    acct.classify = doctored
+
+
+def test_armed_check_catches_doctored_double_count(world, monkeypatch):
+    monkeypatch.setenv("INVCHECK", "1")
+    world.acct.tick()
+    world.clock.advance(5.0)
+    world.acct.tick()  # honest books pass
+    _doctor_double_count(world.acct)
+    world.clock.advance(5.0)
+    with pytest.raises(invcheck.InvariantViolation) as excinfo:
+        world.acct.tick()
+    assert "chip-conservation" in str(excinfo.value)
+    assert "double-counted" in str(excinfo.value)
+
+
+def test_armed_check_catches_unknown_phase(world, monkeypatch):
+    monkeypatch.setenv("INVCHECK", "1")
+    real = world.acct.classify
+
+    def doctored(now=None):
+        attrs = real(now)
+        return [Attribution(a.node, a.chips, a.workload_class, a.obj,
+                            "vibing") for a in attrs]
+
+    world.acct.classify = doctored
+    world.acct.tick()
+    world.clock.advance(5.0)
+    with pytest.raises(invcheck.InvariantViolation):
+        world.acct.tick()
+
+
+def test_disarmed_check_is_inert(world, monkeypatch):
+    """INVCHECK off: the same doctored books tick through without raising —
+    the armed check is opt-in, never a production tax."""
+    monkeypatch.delenv("INVCHECK", raising=False)
+    world.acct.tick()
+    _doctor_double_count(world.acct)
+    world.clock.advance(5.0)
+    banked = world.acct.tick()
+    assert banked > 0  # ticked, no raise (the doctoring went unchallenged)
+
+
+def test_armed_conservation_overhead_under_ten_percent(monkeypatch):
+    """The armed re-verification must stay O(attributions-per-tick) cheap:
+    <10% added wall per tick against the disarmed baseline (absolute floor
+    absorbs CI scheduler noise, the jaxguard/invcheck overhead idiom)."""
+    w = World(slices=8)
+    try:
+        for i in range(4):
+            w.add_notebook(f"nb-{i}")
+            w.bind_pod(f"nb-{i}-pod", f"acct-{i}", CC.NOTEBOOK_NAME_LABEL,
+                       f"nb-{i}")
+        n = 60
+
+        def run_ticks():
+            w.acct.reset_for_test()
+            w.acct.tick()
+            t0 = time.perf_counter()
+            for _ in range(n):
+                w.clock.advance(1.0)
+                w.acct.tick()
+            return time.perf_counter() - t0
+
+        monkeypatch.delenv("INVCHECK", raising=False)
+        disarmed = min(run_ticks() for _ in range(3))
+        monkeypatch.setenv("INVCHECK", "1")
+        armed = min(run_ticks() for _ in range(3))
+        assert armed - disarmed < max(0.10 * disarmed, 0.05), (
+            f"armed {armed:.4f}s vs disarmed {disarmed:.4f}s over {n} ticks"
+        )
+    finally:
+        w.stop()
+
+
+# ---------------------------------------------------------------------------
+# /debug/accounting
+# ---------------------------------------------------------------------------
+
+
+class _StubManager:
+    def __init__(self):
+        from odh_kubeflow_tpu.runtime.metrics import Registry
+
+        self.metrics = Registry()
+
+    def healthz(self):
+        return True
+
+    def readyz(self):
+        return True
+
+
+@pytest.fixture
+def endpoints():
+    from odh_kubeflow_tpu.runtime.serving import ServingEndpoints
+
+    mgr = _StubManager()
+    ep = ServingEndpoints(
+        mgr, metrics_port=0, health_port=0, host="127.0.0.1"
+    ).start()
+    yield ep, mgr
+    ep.stop()
+    accounting.set_current(None)
+
+
+def _get(ep, path):
+    host, port = ep.metrics_address
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=5
+    ) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_debug_accounting_serves_ledger(world, endpoints):
+    ep, mgr = endpoints
+    world.add_notebook("nb-d")
+    world.bind_pod("nb-d-pod", "acct-0", CC.NOTEBOOK_NAME_LABEL, "nb-d")
+    world.acct.tick()
+    world.tick_to(30)
+    mgr.accountant = world.acct
+
+    status, payload = _get(ep, "/debug/accounting")
+    assert status == 200
+    assert payload["ticks"] > 0
+    assert payload["chip_seconds"]["residual_ratio"] == 0.0
+    assert payload["chip_seconds"]["by_phase"]["ready"] \
+        == 30 * CHIPS_PER_SLICE
+    assert payload["fleet_utilization"] is not None
+    assert "job" in payload["goodput_views"]
+
+    # ?class= filters the object rows; ?limit= caps them
+    status, payload = _get(ep, "/debug/accounting?class=notebook")
+    assert status == 200
+    assert all(
+        r["workload_class"] == "notebook" for r in payload["objects"]
+    )
+    assert payload["objects"][0]["object"] == "t/nb-d"
+    status, payload = _get(ep, "/debug/accounting?limit=0")
+    assert status == 200 and payload["objects"] == []
+    status, payload = _get(
+        ep, "/debug/accounting?class=pool&object=acct-1"
+    )
+    assert status == 200
+    assert [r["object"] for r in payload["objects"]] == ["acct-1"]
+
+
+def test_debug_accounting_falls_back_to_module_handle(world, endpoints):
+    ep, _mgr = endpoints  # stub manager has NO accountant attribute
+    world.acct.tick()
+    accounting.set_current(world.acct)
+    status, payload = _get(ep, "/debug/accounting")
+    assert status == 200 and "chip_seconds" in payload
+
+
+def test_debug_accounting_bad_args_and_disabled(world, endpoints):
+    ep, mgr = endpoints
+    mgr.accountant = world.acct
+    host, port = ep.metrics_address
+    for query in ("?limit=nope", "?limit=-1", "?class=flywheel"):
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(
+                f"http://{host}:{port}/debug/accounting{query}", timeout=5
+            )
+        assert excinfo.value.code == 400, query
+    # no accountant anywhere -> 404 names the knob that enables it
+    mgr.accountant = None
+    accounting.set_current(None)
+    with pytest.raises(urllib.error.HTTPError) as excinfo:
+        urllib.request.urlopen(
+            f"http://{host}:{port}/debug/accounting", timeout=5
+        )
+    assert excinfo.value.code == 404
+
+
+def test_debug_index_links_accounting(endpoints):
+    ep, _ = endpoints
+    host, port = ep.metrics_address
+    with urllib.request.urlopen(
+        f"http://{host}:{port}/debug/", timeout=5
+    ) as r:
+        body = r.read().decode()
+    assert "/debug/accounting" in body
+
+
+def test_incident_bundle_freezes_accounting_snapshot(world):
+    from odh_kubeflow_tpu.runtime.flightrecorder import FlightRecorder
+
+    world.add_notebook("nb-f")
+    world.bind_pod("nb-f-pod", "acct-0", CC.NOTEBOOK_NAME_LABEL, "nb-f")
+    world.acct.tick()
+    world.tick_to(10)
+    accounting.set_current(world.acct)
+    try:
+        rec = FlightRecorder()
+        rec.record("slice.degraded", notebook="t/nb-f", cause="test")
+        incident_id = rec.snapshot("fleet-utilization", subject="fleet")
+        bundle = rec.get(incident_id)
+        assert bundle["accounting"]["ticks"] > 0
+        assert bundle["accounting"]["chip_seconds"]["residual_ratio"] == 0.0
+    finally:
+        accounting.set_current(None)
+
+
+# ---------------------------------------------------------------------------
+# goodput views: the migrated integrators + the reset bugfix
+# ---------------------------------------------------------------------------
+
+
+def test_job_goodput_reset_between_tiers_regression():
+    """ISSUE 17 bugfix: the old module-level _goodput dict survived across
+    loadtest tiers, so a later tier's ratio inherited stale wall-clock.
+    reset_for_test() starts a tier from the never-set state."""
+    from odh_kubeflow_tpu.runtime import jobmetrics
+
+    jobmetrics.reset_for_test()
+    try:
+        # tier 1: half the wall was productive
+        jobmetrics.record_job_outcome(50.0, 100.0)
+        assert jobmetrics.tpu_job_goodput_ratio.value() == pytest.approx(0.5)
+        # back-to-back tier WITHOUT reset would blend: (50+100)/(100+100)
+        jobmetrics.reset_for_test()
+        assert jobmetrics.tpu_job_goodput_ratio.series() == []  # no-data
+        jobmetrics.record_job_outcome(100.0, 100.0)
+        assert jobmetrics.tpu_job_goodput_ratio.value() == pytest.approx(
+            1.0
+        ), "a fresh tier must not inherit the previous tier's wall-clock"
+    finally:
+        jobmetrics.reset_for_test()
+
+
+def test_slice_goodput_view_over_shared_ledger():
+    from odh_kubeflow_tpu.tpu import telemetry
+
+    telemetry.goodput.reset_for_test()
+    try:
+        telemetry.goodput.observe(100.0, downtime_s=20.0)
+        assert telemetry.slice_goodput_ratio.value() == pytest.approx(0.8)
+        # both views surface in the accountant snapshot
+        w = World(slices=1)
+        try:
+            views = w.acct.snapshot()["goodput_views"]
+            assert views["slice"]["ratio"] == pytest.approx(0.8)
+            assert views["slice"]["observed_s"] == pytest.approx(100.0)
+        finally:
+            w.stop()
+        telemetry.goodput.reset_for_test()
+        assert telemetry.slice_goodput_ratio.series() == []
+    finally:
+        telemetry.goodput.reset_for_test()

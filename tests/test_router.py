@@ -484,3 +484,138 @@ def test_router_metric_families_render():
     ):
         assert family in text, family
     assert sm.inference_router_picks_total.value(result="ok") >= 1
+
+
+# ---------------------------------------------------------------------------
+# trace stitching (ISSUE 17 satellite): router -> replica -> first token is
+# ONE connected trace tree in the tracing buffer
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def traced():
+    from odh_kubeflow_tpu.utils import tracing
+
+    tracing.set_enabled(True)
+    tracing.clear()
+    yield tracing
+    tracing.clear()
+
+
+def test_routed_request_is_a_single_trace_tree(traced):
+    """An incoming traceparent flows through the router's envelope span into
+    the replica submit, so the REAL engine's inference.request (which carries
+    the first-token latency) lands in the same tree: incoming -> router.request
+    -> {router.pick, inference.request}."""
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import TransformerConfig, init_params
+    from odh_kubeflow_tpu.serving.engine import ServingEngine
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, d_ff=32, max_seq=32,
+        dtype=jnp.float32, use_flash=False, remat=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = ServingEngine(params, cfg, max_slots=2, max_seq=32).start()
+    try:
+        router = TokenRouter(endpoint="ns/ep")
+        router.add_replica(0, engine)
+        trace_id = traced.new_trace_id()
+        caller_span = traced.new_span_id()
+        incoming = traced.format_traceparent(trace_id, caller_span)
+        res = router.generate(
+            [1, 2, 3], max_new=2, wait_timeout_s=30, traceparent=incoming
+        )
+        assert res.handle.result == "ok"
+    finally:
+        engine.stop()
+
+    spans = {s.name: s for s in traced.global_buffer.spans(trace_id=trace_id)}
+    assert {"router.request", "router.pick", "inference.request"} <= set(spans)
+    # every span joined the CALLER's trace — no orphan trace ids anywhere
+    for s in spans.values():
+        assert s.trace_id == trace_id, s.name
+    envelope = spans["router.request"]
+    assert envelope.parent_id == caller_span
+    assert envelope.attributes["result"] == "ok"
+    # pick + the engine-side request both hang off the router envelope
+    assert spans["router.pick"].parent_id == envelope.span_id
+    assert spans["inference.request"].parent_id == envelope.span_id
+    # the engine span is the first-token record: ttft rode the same tree
+    assert spans["inference.request"].attributes["ttft_s"] is not None
+    assert spans["inference.request"].attributes["superseded"] is False
+
+
+def test_routed_failure_envelope_and_retry_spans_share_the_trace(traced):
+    broken = FakeEngine(mode="error", queued=0)
+    healthy = FakeEngine(queued=1)
+    router, _ = mk_router([broken, healthy], breaker_failure_threshold=1)
+    trace_id = traced.new_trace_id()
+    incoming = traced.format_traceparent(trace_id, traced.new_span_id())
+    res = router.generate([1, 2], max_new=4, traceparent=incoming)
+    assert res.replica == 1 and res.retries == 1
+    spans = traced.global_buffer.spans(trace_id=trace_id)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    envelope = by_name["router.request"][0]
+    # the cross-replica retry is a visible child of the routed request
+    assert by_name["router.retry"][0].parent_id == envelope.span_id
+    assert by_name["router.retry"][0].attributes["reason"] == "error"
+    assert len(by_name["router.pick"]) == 2  # original + retry pick
+
+
+def test_hedged_loser_is_canceled_superseded_in_the_same_trace(traced):
+    """The hedge loser's cancellation stays inside the routed request's trace
+    but is explicitly marked: the router sets handle.superseded BEFORE the
+    cancel, and the real engine's completion span carries the tag."""
+    import jax
+    import jax.numpy as jnp
+
+    from odh_kubeflow_tpu.models import TransformerConfig, init_params
+    from odh_kubeflow_tpu.serving.engine import ServingEngine
+
+    # router half: the FakeEngine hedge race proves the loser handle is
+    # tagged superseded before cancel
+    stuck = FakeEngine(mode="hang", queued=0)  # preferred, never finishes
+    quick = FakeEngine(queued=1)
+    router, _ = mk_router([stuck, quick], hedge_after_s=0.001, clk=FakeClock())
+    router.clock = time.monotonic  # hedging polls both handles on wall time
+    router.sleep = time.sleep
+    trace_id = traced.new_trace_id()
+    incoming = traced.format_traceparent(trace_id, traced.new_span_id())
+    res = router.generate(
+        [1], max_new=2, wait_timeout_s=5.0, traceparent=incoming
+    )
+    assert res.hedged and res.hedge_won
+    assert stuck.canceled and stuck.canceled[0].superseded is True
+    hedge_spans = [
+        s for s in traced.global_buffer.spans(trace_id=trace_id)
+        if s.name == "router.hedge"
+    ]
+    assert hedge_spans and hedge_spans[0].attributes["hedge"] == 1
+
+    # engine half: a superseded cancel through the REAL engine records an
+    # inference.request span tagged superseded=True in the same trace
+    cfg = TransformerConfig(
+        vocab=64, d_model=16, n_layers=1, n_heads=2, d_ff=32, max_seq=32,
+        dtype=jnp.float32, use_flash=False, remat=False,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    engine = ServingEngine(params, cfg, max_slots=2, max_seq=32)
+    try:
+        ctx = traced.format_traceparent(trace_id, traced.new_span_id())
+        handle = engine.submit([1, 2], max_new=8, traceparent=ctx)
+        handle.superseded = True  # exactly what the router does to a loser
+        assert engine.cancel(handle)
+    finally:
+        engine.stop()
+    loser_spans = [
+        s for s in traced.global_buffer.spans(trace_id=trace_id)
+        if s.name == "inference.request"
+    ]
+    assert loser_spans
+    assert loser_spans[-1].attributes["superseded"] is True
+    assert loser_spans[-1].attributes["result"] == "canceled"

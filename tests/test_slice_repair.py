@@ -309,8 +309,10 @@ def test_goodput_integrator_matches_episode_downtime(env):
     # settle, then anchor the integrator so the healthy pre-fault interval
     # is part of the observed (uptime) side of the ledger
     time.sleep(0.5)
-    down0 = telemetry.goodput._downtime_s
-    observed0 = telemetry.goodput._observed_s
+    # the accumulators live in the fleet accounting ledger since round 17:
+    # totals() is (good_s, observed_s), downtime is their gap
+    good0, observed0 = telemetry.goodput._ledger.totals()
+    down0 = observed0 - good0
 
     victim = pod_node(cluster, "gp-0")
     cluster.preempt_node(victim, grace_s=5.0)
@@ -322,8 +324,9 @@ def test_goodput_integrator_matches_episode_downtime(env):
         if s["attributes"].get("notebook") == "gp"
     )
     mttr = float(span["attributes"]["mttr_s"])
-    downtime = telemetry.goodput._downtime_s - down0
-    observed = telemetry.goodput._observed_s - observed0
+    good1, observed1 = telemetry.goodput._ledger.totals()
+    downtime = (observed1 - good1) - down0
+    observed = observed1 - observed0
     assert mttr > 0
     # the integral is sampled at reconcile boundaries: allow a probe-period
     # of slack either side, but it must track the episode's clock
