@@ -1727,15 +1727,26 @@ def _bench_batch_contention():
 
 
 def bench_control_plane():
+    import os
+
     from odh_kubeflow_tpu.api.core import Container
     from odh_kubeflow_tpu.api.notebook import Notebook, TPUSpec
     from odh_kubeflow_tpu.cluster import SimCluster
     from odh_kubeflow_tpu.controllers import Config
     from odh_kubeflow_tpu.main import build_manager
     from odh_kubeflow_tpu.probe import sim_agent_behavior
+    from odh_kubeflow_tpu.runtime import cpprofile
     from odh_kubeflow_tpu.utils import tracing
 
     tracing.clear()  # this run's traces only
+    # CPPROFILE=1 for the storm episode (ISSUE 20): reconcile-cause /
+    # cache-scan accounting across the real controller suite plus the
+    # takeover decomposition — two ledger headlines ride this
+    # (cache_scans_per_reconcile, takeover_relist_share). Scoped to this
+    # episode with save/restore, same idiom as bench_accounting's INVCHECK.
+    prev_cpprofile = os.environ.get("CPPROFILE")
+    os.environ["CPPROFILE"] = "1"
+    cpprofile.reset()
 
     def make_notebook(name, accelerator, topology):
         nb = Notebook()
@@ -1820,9 +1831,64 @@ def bench_control_plane():
             slo_section = _bench_slo_and_canary(mgr)
         except Exception as e:
             slo_section = {"error": repr(e)[:300]}
+
+        # control-plane profile (ISSUE 20): freeze the episode's cause/scan
+        # accounting while both managers are still live (stopping them
+        # abandons in-flight takeover trackers). cache_scans_per_reconcile
+        # is the fleet-wide flat-cache cost — cached objects walked per
+        # reconcile across every controller; takeover_relist_share is the
+        # fraction of completed takeover wall-clock spent relisting. Both
+        # are the denominators ROADMAP item 5's indexing/fan-out refactor
+        # is ledger-gated against; lower is better.
+        try:
+            cp = cpprofile.snapshot(limit=0)
+            total_recon = sum(
+                s["reconciles"] for s in cp["controllers"].values()
+            )
+            total_scanned = sum(
+                s["scanned"] for s in cp["controllers"].values()
+            )
+            completed = [t for t in cp["takeovers"] if t.get("complete")]
+            relist_s = sum(t["phases"]["relist"] for t in completed)
+            takeover_s = sum(t["total_s"] for t in completed)
+            top_scanners = dict(sorted(
+                (
+                    (name, {
+                        "reconciles": s["reconciles"],
+                        "scanned": s["scanned"],
+                        "used": s["used"],
+                        "scans_per_reconcile": s["scans_per_reconcile"],
+                        "causes": dict(list(s["causes"].items())[:4]),
+                    })
+                    for name, s in cp["controllers"].items()
+                ),
+                key=lambda kv: kv[1]["scanned"], reverse=True,
+            )[:5])
+            cpprofile_section = {
+                "cache_scans_per_reconcile": (
+                    round(total_scanned / total_recon, 4)
+                    if total_recon else None
+                ),
+                "takeover_relist_share": (
+                    round(relist_s / takeover_s, 4) if takeover_s else None
+                ),
+                "reconciles": total_recon,
+                "objects_scanned": total_scanned,
+                "takeovers": completed,
+                "top_scanners": top_scanners,
+                "note": "storm episode only (CPPROFILE armed for this "
+                        "cluster + manager pair); scans_per_reconcile is "
+                        "the flat-cache walk cost ROADMAP item 5 targets",
+            }
+        except Exception as e:
+            cpprofile_section = {"error": repr(e)[:300]}
     finally:
         mgr.stop()
         cluster.stop()
+        if prev_cpprofile is None:
+            os.environ.pop("CPPROFILE", None)
+        else:
+            os.environ["CPPROFILE"] = prev_cpprofile
 
     # suspend/resume churn (ISSUE 7): its own cluster, so the modeled cold
     # mesh-formation delay doesn't distort the storm numbers above
@@ -1866,6 +1932,7 @@ def bench_control_plane():
         "suspend_resume": suspend_resume,
         "batch": batch,
         "flowcontrol": flow_levels,
+        "cpprofile": cpprofile_section,
         **out_slo,
         "cr_to_mesh_ready_p50_s": round(statistics.median(latencies.values()), 4),
         # where the time goes: per-phase p50 from the connected readiness

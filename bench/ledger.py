@@ -141,6 +141,34 @@ HEADLINES: Tuple[Headline, ...] = (
              "overhead included); deterministic sim clock, tight tolerance",
     ),
     Headline(
+        name="cache_scans_per_reconcile",
+        path=("detail", "control_plane", "cpprofile",
+              "cache_scans_per_reconcile"),
+        direction="lower",
+        tolerance=0.75,
+        note="CPPROFILE=1 fleet-wide flat-cache walk cost over the storm "
+             "episode: cached objects scanned per reconcile across every "
+             "controller. The denominator ROADMAP item 5's indexing/"
+             "fan-out refactor is gated against; the cause MIX shifts with "
+             "host-scheduling-dependent requeue counts, so the tolerance "
+             "is wide and only order-of-magnitude breaks gate; no "
+             "committed round carries it yet (vs_prior null until the "
+             "first post-ISSUE 20 round)",
+    ),
+    Headline(
+        name="takeover_relist_share",
+        path=("detail", "control_plane", "cpprofile",
+              "takeover_relist_share"),
+        direction="lower",
+        tolerance=0.75,
+        note="CPPROFILE=1 share of completed manager-takeover wall-clock "
+             "spent in the relist phase (aggregate over the episode's "
+             "managers). The cold-cache cost a delta-relist would remove; "
+             "phase boundaries ride host scheduling, so wide tolerance — "
+             "order-of-magnitude breaks only; no committed round carries "
+             "it yet",
+    ),
+    Headline(
         name="cr_to_mesh_ready_p50_s",
         path=("detail", "control_plane", "cr_to_mesh_ready_p50_s"),
         direction="lower",

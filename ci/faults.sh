@@ -178,6 +178,14 @@ echo "=== overload lane: INVCHECK=1 iteration ==="
 INVCHECK=1 python -m pytest tests/test_overload.py tests/test_sharding.py tests/test_flowcontrol.py \
     -q -m "(overload or flowcontrol) and not slow" \
     -p no:cacheprovider -p no:randomly "$@"
+# CPPROFILE=1 (ISSUE 20): the control-plane profiler rides the widest
+# informer->workqueue->reconcile churn in the suite — cause stamping, scan
+# accounting and takeover tracking must never deadlock or change overload/
+# fencing semantics while armed
+echo "=== overload lane: CPPROFILE=1 iteration ==="
+CPPROFILE=1 python -m pytest tests/test_overload.py tests/test_sharding.py tests/test_flowcontrol.py \
+    -q -m "(overload or flowcontrol) and not slow" \
+    -p no:cacheprovider -p no:randomly "$@"
 
 # the overload lane's DEPLOYGUARD=1 iteration doubles as the surface
 # recorder: the shard-failover storm exercises the widest (flow, verb, kind)

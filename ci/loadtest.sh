@@ -26,7 +26,11 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 # request exceeding the declared RBAC — fails the tier at the offending call
 # instead of leaking into the fairness accounting
 export DEPLOYGUARD="${DEPLOYGUARD:-1}"
+# control-plane profiler (ISSUE 20): the tier runs armed so the report
+# carries per-controller reconcile-cause/cache-scan breakdowns and the
+# kill lane's takeover decomposed into its five phases
+export CPPROFILE="${CPPROFILE:-1}"
 
-echo "=== loadtest lane: ${TIER}-object tier (DEPLOYGUARD=$DEPLOYGUARD) ==="
+echo "=== loadtest lane: ${TIER}-object tier (DEPLOYGUARD=$DEPLOYGUARD CPPROFILE=$CPPROFILE) ==="
 python loadtest/tiers.py --objects "$TIER" "$@"
 echo "=== loadtest lane: ${TIER}-object tier passed its SLO verdict ==="

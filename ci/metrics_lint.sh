@@ -5,6 +5,12 @@
 # truth, shared with the static metric-convention AST checker — then rerun
 # the observability-marked pytest contract tests (exposition round-trip,
 # +Inf buckets, label escaping).
+#
+# Since ISSUE 20 the registry lint also covers the CPPROFILE=1 control-plane
+# profiler families (runtime/cpprofile.py, registered at import): the
+# cp_reconcile_cause_total / cp_cache_scan_objects_total counters and the
+# cp_queue_wait / cp_reconcile_work / cp_takeover_phase histograms, whose
+# sub-ms bucket layouts are range-checked against HISTOGRAM_RANGES.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -29,6 +29,12 @@
 # tpu_chip_seconds_total{workload_class,phase} ledger family
 # (runtime/accounting.py — jax-free again), so the conservation ledger's
 # exported surface is lint-checked with everything else.
+#
+# Since ISSUE 20 the live registry the lint loads also carries the
+# CPPROFILE=1 control-plane profiler families (runtime/cpprofile.py —
+# jax-free, registered at import): cp_reconcile_cause_total,
+# cp_cache_scan_objects_total, and the cp_* queue-wait/work/takeover-phase
+# histograms, so an SLO or alert referencing them resolves here too.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -123,6 +123,11 @@ def run(args) -> None:
             PriorityLevel("system", seats=16, queue_length=64, queue_timeout_s=10.0),
             PriorityLevel("workload-high", seats=12, queue_length=64,
                           queue_timeout_s=10.0),
+            # the ISSUE-16 serving-requests schema names this level; the
+            # pinned layout must carry it or FlowController refuses the
+            # schema set at construction
+            PriorityLevel("serving", seats=8, queue_length=32,
+                          queue_timeout_s=5.0),
             PriorityLevel("batch", seats=4, queue_length=4, queue_timeout_s=0.3),
             PriorityLevel("default", seats=8, queue_length=32, queue_timeout_s=5.0),
         ],
@@ -184,11 +189,14 @@ def run(args) -> None:
     # back-to-back tiers share one process: the cumulative goodput ledgers
     # (runtime/accounting.py) must not inherit a previous tier's wall-clock
     # (ISSUE 17 bugfix — the old module-level accumulators never reset)
-    from odh_kubeflow_tpu.runtime import jobmetrics
+    from odh_kubeflow_tpu.runtime import cpprofile, jobmetrics
     from odh_kubeflow_tpu.tpu import telemetry as tpu_telemetry
 
     jobmetrics.reset_for_test()
     tpu_telemetry.goodput.reset_for_test()
+    # CPPROFILE (ISSUE 20): back-to-back tiers must not inherit a previous
+    # tier's cause/scan aggregates or takeover rows either
+    cpprofile.reset()
 
     fenced0 = rm.fenced_writes_total.value()
     mgr0.start(wait_for_leadership_timeout=10)
@@ -568,6 +576,47 @@ def run(args) -> None:
             }
 
         # ------------------------------------------------------------------
+        # control-plane profile (ISSUE 20): when the tier runs CPPROFILE=1
+        # (the ci/loadtest.sh default) the report carries the per-controller
+        # cause/scan breakdown — why each controller's reconciles fired and
+        # how many cached objects they walked — and the kill lane's takeover
+        # is decomposed into its five phases from the SURVIVOR's tracker
+        # ------------------------------------------------------------------
+        cpprofile_section = None
+        if cpprofile.enabled():
+            cp = cpprofile.snapshot(limit=0)  # aggregates, not sample rows
+            if not cp["controllers"]:
+                failures.append(
+                    "CPPROFILE armed but no reconcile causes recorded"
+                )
+            survivor_takeover = None
+            for t in cp["takeovers"]:
+                if (t.get("complete")
+                        and t.get("manager") == standby.elector.identity):
+                    survivor_takeover = t
+            if takeover_s is not None and survivor_takeover is None:
+                failures.append(
+                    "CPPROFILE armed but the survivor's takeover was never "
+                    "decomposed into phases"
+                )
+            cpprofile_section = {
+                "controllers": {
+                    name: {
+                        "reconciles": s["reconciles"],
+                        "causes": s["causes"],
+                        "origins": s["origins"],
+                        "scan_calls": s["scan_calls"],
+                        "scanned": s["scanned"],
+                        "used": s["used"],
+                        "scans_per_reconcile": s["scans_per_reconcile"],
+                    }
+                    for name, s in cp["controllers"].items()
+                },
+                "sweeps": cp["sweeps"],
+                "survivor_takeover": survivor_takeover,
+            }
+
+        # ------------------------------------------------------------------
         # the verdict comes from the SURVIVOR's judgement layer
         # ------------------------------------------------------------------
         statuses = standby.slo_engine.evaluate()
@@ -631,6 +680,7 @@ def run(args) -> None:
                 for level, stats in summary.items()
             },
             "accounting": accounting_section,
+            "cpprofile": cpprofile_section,
             "slo_gates": gates,
             "alerts_firing_gated": list(firing),
             "alerts_firing_all": list(all_firing),
@@ -1098,6 +1148,10 @@ def main() -> None:
     # onto a workload flow after the shard failover, say) is a hard
     # RBACDriftError at the call, not a silent fairness leak
     os.environ.setdefault("DEPLOYGUARD", "1")
+    # control-plane profiler (ISSUE 20): the tier always runs armed
+    # (CPPROFILE=0 opts out) — the report gains the per-controller
+    # cause/scan breakdown and the kill lane's takeover decomposition
+    os.environ.setdefault("CPPROFILE", "1")
     ap = argparse.ArgumentParser()
     ap.add_argument("--tier", default="mixed", choices=("mixed", "fleet"),
                     help="mixed: the 200/500-object control-plane tier; "

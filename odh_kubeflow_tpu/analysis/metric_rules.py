@@ -28,8 +28,17 @@ HISTOGRAM_RANGES = {
     "notebook_probe_sweep_seconds": (0.001, 10.0),
     "notebook_resume_seconds": (0.05, 300.0),
     "flowcontrol_wait_seconds": (0.001, 60.0),
-    "workqueue_queue_duration_seconds": (0.001, 60.0),
-    "controller_reconcile_duration_seconds": (0.001, 60.0),
+    # sim-mode reconciles land sub-ms (ISSUE 20 audit: the old 1ms low end
+    # saturated the first bucket, making queue-wait p50s unreadable)
+    "workqueue_queue_duration_seconds": (0.0001, 60.0),
+    "controller_reconcile_duration_seconds": (0.0001, 60.0),
+    # CPPROFILE=1 control-plane profiler families (runtime/cpprofile.py):
+    # queue-wait/work share the sub-ms reconcile range; takeover phases run
+    # from sub-ms (no-op lease acquire in sim) to tens of seconds (relist
+    # at population under a real apiserver)
+    "cp_queue_wait_seconds": (0.0001, 60.0),
+    "cp_reconcile_work_seconds": (0.0001, 60.0),
+    "cp_takeover_phase_seconds": (0.001, 60.0),
     "canary_probe_latency_seconds": (0.1, 300.0),
     "tpu_job_queue_wait_seconds": (0.05, 1800.0),
     "tpu_job_completion_seconds": (0.5, 7200.0),

@@ -42,6 +42,21 @@ from ..apimachinery import (
 )
 from ..utils import invcheck, racecheck
 
+# CPPROFILE scan-accounting hook (runtime/cpprofile.py), resolved lazily and
+# cached: cluster modules must not import the runtime package at load time
+# (runtime.manager imports cluster.client while runtime/__init__ is mid-init)
+_cpprofile_mod = None
+
+
+def _cpprofile():
+    global _cpprofile_mod
+    if _cpprofile_mod is None:
+        from ..runtime import cpprofile
+
+        _cpprofile_mod = cpprofile
+    return _cpprofile_mod
+
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
@@ -174,6 +189,9 @@ class _PyBucket:
         raw = self._objs.get(key)
         return None if raw is None else json.loads(raw)
 
+    def __len__(self) -> int:
+        return len(self._objs)
+
     def raw(self, key: str) -> str:
         return self._objs[key]
 
@@ -226,6 +244,9 @@ class _NativeBucket:
     def get(self, key: str) -> Optional[Dict[str, Any]]:
         raw = self._mirror.get(key)
         return None if raw is None else json.loads(raw)
+
+    def __len__(self) -> int:
+        return len(self._mirror)
 
     def raw(self, key: str) -> str:
         return self._mirror[key]
@@ -496,6 +517,7 @@ class Store:
             self.faults.check("store.read", kind=kind, verb="list")
         with self._lock:
             bucket = self._bucket(api_version, kind)
+            scanned = len(bucket)
             if isinstance(bucket, _NativeBucket):
                 out = bucket.list_filtered(namespace, label_selector)
             else:
@@ -508,7 +530,12 @@ class Store:
                         continue
                     out.append(obj)
             out.sort(key=lambda o: (o["metadata"].get("namespace", ""), o["metadata"]["name"]))
-            return out
+        # CPPROFILE=1 scan accounting (ISSUE 20): the DIRECT list path — the
+        # system manager's scheduler/kubelet sweeps and every other uncached
+        # read walk (or natively filter over) the whole kind bucket. Outside
+        # the store lock; one cached-module + env check when disarmed.
+        _cpprofile().note_scan(kind, scanned, len(out))
+        return out
 
     def peek_raw(
         self, api_version: str, kind: str

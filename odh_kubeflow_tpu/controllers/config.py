@@ -502,4 +502,7 @@ ENV_CONTRACT: tuple = (
             "dump the recorded (flow, verb, kind) surface to this path"),
     EnvKnob("PROFILE", "0", "utils/profiler.py",
             "arm the continuous data-plane profiler"),
+    EnvKnob("CPPROFILE", "0", "runtime/cpprofile.py",
+            "arm the control-plane profiler (reconcile causes, cache-scan "
+            "accounting, takeover decomposition)"),
 )

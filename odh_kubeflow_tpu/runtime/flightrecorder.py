@@ -169,6 +169,19 @@ class FlightRecorder:
                     bundle["profile"] = prof
         except Exception:  # pragma: no cover - never costs the bundle
             pass
+        # CPPROFILE=1 (ISSUE 20): freeze the control-plane profiler — an
+        # incident carries its own why-did-the-reconciles-fire evidence
+        # (cause mix, scan accounting, takeover decomposition). Same
+        # never-costs-the-bundle discipline as the profiler block above.
+        try:
+            from . import cpprofile
+
+            if cpprofile.enabled():
+                cp = cpprofile.snapshot(limit=5)
+                if cp["controllers"] or cp["takeovers"]:
+                    bundle["cpprofile"] = cp
+        except Exception:  # pragma: no cover - never costs the bundle
+            pass
         # ISSUE 17: freeze the fleet chip-time ledger — an incident carries
         # its own where-did-the-chips-go evidence (per-phase chip-seconds,
         # conservation arithmetic, top consumers). Same never-costs-the-

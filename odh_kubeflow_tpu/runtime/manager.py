@@ -30,6 +30,7 @@ from ..apimachinery import (
 )
 from ..cluster.client import Client
 from ..cluster.store import Store
+from . import cpprofile
 from .controller import Controller
 from .informer import InformerRegistry
 from .metrics import Registry, global_registry
@@ -312,17 +313,43 @@ class Manager:
         into CrashLoopBackOff. A timeout is for tests."""
         if self._started:
             return
+        # CPPROFILE=1 takeover decomposition (runtime/cpprofile.py): phase
+        # marks bracket the sequential legs of bring-up — lease-acquire,
+        # relist (informer sync), cache-warm (controller/service start) —
+        # and the tracker stays live past start() to catch first-sweep
+        # (first reconcile completion) and first-owned-write (first write
+        # through THIS manager's fenced clients). None disarmed.
+        tracker = cpprofile.takeover_begin(
+            self.elector.identity if self.elector is not None
+            else f"manager-{id(self) & 0xFFFFFF:x}",
+            {id(self.client), id(self.api_reader)},
+        )
+        self._cp_takeover = tracker
         if self.elector is not None:
             self.elector.on_stopped_leading = self.stop
             self.elector.start()
             if wait_for_leadership_timeout is not None:
-                if not self.elector.is_leader.wait(timeout=wait_for_leadership_timeout):
-                    raise TimeoutError("failed to acquire leadership")
+                deadline = time.monotonic() + wait_for_leadership_timeout
+                while not self.elector.is_leader.wait(
+                    timeout=min(0.2, max(0.01, deadline - time.monotonic()))
+                ):
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError("failed to acquire leadership")
+                    if tracker is not None:
+                        # still waiting: lease-acquire must measure the
+                        # acquisition, not the standby's healthy wait
+                        tracker.touch_waiting()
             else:
                 while not self.elector.is_leader.wait(timeout=1.0):
                     if self.elector._stop.is_set():
                         return
+                    if tracker is not None:
+                        tracker.touch_waiting()
+        if tracker is not None:
+            tracker.mark("leader")
         self.informers.start_all()
+        if tracker is not None:
+            tracker.mark("synced")
         for ctrl in self.controllers:
             ctrl.start()
         for fn in self._runnables:
@@ -330,8 +357,17 @@ class Manager:
         for service in self._services:
             service.start()
         self._started = True
+        if tracker is not None:
+            tracker.mark(
+                "started",
+                controller_ids={id(c) for c in self.controllers},
+            )
 
     def stop(self) -> None:
+        tracker = getattr(self, "_cp_takeover", None)
+        if tracker is not None:
+            tracker.abandon()  # no-op if the decomposition already completed
+            self._cp_takeover = None
         for service in self._services:
             try:
                 service.stop()

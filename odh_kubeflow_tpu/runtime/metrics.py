@@ -375,7 +375,11 @@ endpoint_desired_replicas_gauge = global_registry.gauge(
 # reconcile / informer series every controller dashboard expects, emitted by
 # runtime/workqueue.py, runtime/controller.py and runtime/informer.py ----
 
-_QUEUE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30, 60)
+# sub-ms low end (ISSUE 20 bucket audit): a sim-mode reconcile dequeues and
+# completes in tens of microseconds, so the old 1ms first bucket saturated —
+# every queue-wait p50 read as "<=1ms" with zero resolution underneath
+_QUEUE_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                  0.5, 1, 5, 10, 30, 60)
 
 workqueue_depth = global_registry.gauge(
     "workqueue_depth",

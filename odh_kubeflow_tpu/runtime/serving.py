@@ -153,6 +153,37 @@ class ServingEndpoints:
                             respond_json({"error": "limit must be >= 0"}, 400)
                             return
                     respond_json(profiler.snapshot(region=region, limit=limit))
+                elif path == "/debug/reconciles":
+                    # CPPROFILE=1 control-plane profiler (ISSUE 20):
+                    # per-controller reconcile-cause mix, queue-wait/work
+                    # totals, cache-scan accounting, recent samples, sweep
+                    # table and takeover decompositions. ?controller=
+                    # narrows to one controller with recorded reconciles,
+                    # ?limit= caps the sample rows; bad args are a 400,
+                    # same contract as /debug/profile
+                    from . import cpprofile
+
+                    ctrl = query.get("controller")
+                    if ctrl is not None:
+                        known = sorted(cpprofile.snapshot(limit=0)["controllers"])
+                        if ctrl not in known:
+                            respond_json(
+                                {"error": f"unknown controller {ctrl!r}; "
+                                          f"known: {known}"},
+                                400,
+                            )
+                            return
+                    limit = None
+                    if "limit" in query:
+                        try:
+                            limit = int(query["limit"])
+                        except ValueError:
+                            respond_json({"error": "limit must be an integer"}, 400)
+                            return
+                        if limit < 0:
+                            respond_json({"error": "limit must be >= 0"}, 400)
+                            return
+                    respond_json(cpprofile.snapshot(controller=ctrl, limit=limit))
                 elif path == "/debug/accounting":
                     # fleet chip-time ledger (ISSUE 17): the conservation
                     # arithmetic, per-phase/per-class chip-seconds, and the
@@ -270,6 +301,9 @@ class ServingEndpoints:
             b"PROFILE=1 hot-region timings (?region=, ?limit=)</li>"
             b'<li><a href="/debug/accounting">/debug/accounting</a> &mdash; '
             b"fleet chip-time ledger (?class=, ?object=, ?limit=)</li>"
+            b'<li><a href="/debug/reconciles">/debug/reconciles</a> &mdash; '
+            b"CPPROFILE=1 reconcile causes, cache scans, takeover phases "
+            b"(?controller=, ?limit=)</li>"
             b'<li><a href="/healthz">/healthz</a></li>'
             b"</ul></body></html>\n"
         )
